@@ -1,0 +1,30 @@
+"""bcfl_tpu — TPU-native framework for communication-efficient asynchronous
+peer-to-peer federated LLM fine-tuning with a blockchain-style weight ledger.
+
+A ground-up JAX/XLA/Pallas redesign of the capabilities of the reference repo
+``Sreebhargavibalijaa/Building-Communication-Efficient-Asynchronous-Peer-to-Peer-
+Federated-LLMs-with-Blockchain`` (see ``SURVEY.md``):
+
+- every federated client is a slot on a ``clients`` mesh axis — one TPU chip
+  (or a vmapped stack of clients per chip); all clients train one round inside
+  a single compiled XLA program,
+- server-mode FedAvg lowers to a masked ``jax.lax.psum`` over ICI
+  (reference: Flower ``FedAvg`` strategy, ``src/Servercase/server_IID_IMDB.py:205-218``),
+- serverless P2P gossip lowers to ``jax.lax.ppermute`` along a ring
+  (reference: hand-rolled averaging loop,
+  ``src/Serverlesscase/serverless_NonIID_IMDB.py:284-297``),
+- the anomaly-node filters (PageRank / DBSCAN / modified-Z / communities) and
+  the hash-chained weight ledger run on the TPU-VM host and gate which clients
+  contribute to each aggregation round (reference: offline notebook analysis,
+  ``All_graphs_IMDB_dataset.ipynb``),
+- async mode is host-scheduled with staleness-weighted aggregation; the
+  sync/async information-passing-time model of the reference notebooks is
+  implemented for real in :mod:`bcfl_tpu.topology`.
+
+Nothing is copied from the reference; it is Python/torch/Flower, this is
+JAX-first. Reference citations in docstrings are for behavioural parity only.
+"""
+
+__version__ = "0.1.0"
+
+from bcfl_tpu.config import FedConfig  # noqa: F401

@@ -1,0 +1,147 @@
+"""Single configuration surface for the whole framework.
+
+The reference has no config system: its configuration space is 11 near-copy
+scripts whose deltas are module-level constants (``CHECKPOINT``,
+``NUM_CLIENTS``, ``NUM_ROUNDS``, ``DEVICE``, dataset + column names, partition
+arithmetic) — see SURVEY.md §2.1 for the per-file matrix. Here that space is
+one frozen dataclass; the 11 scripts become presets in
+:mod:`bcfl_tpu.entrypoints.presets`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionConfig:
+    """How each client selects its local train/test subset.
+
+    ``iid``: every client draws ``iid_samples`` random examples
+    (reference: ``random.sample(range(len(ds)), 100)``,
+    ``src/Serverlesscase/serverless_IID_IMDB.py:60-65``), optionally a fresh
+    resample each round (``resample_each_round``, reference behaviour at
+    ``serverless_IID_IMDB.py:258``).
+
+    ``contiguous`` (Non-IID): client ``k`` takes the index slice
+    ``[stride*k, stride*k + train_span)`` for train and either the trailing
+    slice ``[stride*k + train_span, stride*(k+1))`` (``test_mode='trailing'``,
+    reference ``serverless_NonIID_IMDB.py:59-60`` — the 300k/240 schedule) or a
+    fixed shared slice ``[0, test_span)`` (``test_mode='fixed'``, reference
+    ``Serverless_NonIID_Medical_transcriptions.py:55-56`` — the 500i/400
+    schedule).
+    """
+
+    kind: str = "iid"  # "iid" | "contiguous"
+    iid_samples: int = 100
+    iid_test_samples: Optional[int] = None  # default: same as iid_samples
+    resample_each_round: bool = False
+    stride: int = 300
+    train_span: int = 240
+    test_span: int = 60
+    test_mode: str = "trailing"  # "trailing" | "fixed"
+
+    def __post_init__(self):
+        if self.kind not in ("iid", "contiguous"):
+            raise ValueError(f"unknown partition kind: {self.kind!r}")
+        if self.test_mode not in ("trailing", "fixed"):
+            raise ValueError(f"unknown test_mode: {self.test_mode!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """P2P network model + anomaly gating (reference: notebook-only, cells 0-12
+    of ``All_graphs_IMDB_dataset.ipynb``; here it is wired into training)."""
+
+    anomaly_filter: Optional[str] = None  # None|"pagerank"|"dbscan"|"zscore"|"community"
+    # bandwidth matrix source: "reference" = the notebook's fixed 10-node graph,
+    # "random" = sampled in [bw_low, bw_high] mbps like the notebook's values.
+    bandwidth: str = "reference"
+    bw_low: float = 88.0
+    bw_high: float = 496.0
+    # gossip mixing coefficient for ring gossip (serverless mode)
+    gossip_alpha: float = 0.5
+    gossip_steps: int = 1  # ring-gossip rounds per federated round
+
+
+@dataclasses.dataclass(frozen=True)
+class LedgerConfig:
+    """Hash-chained weight ledger (the real implementation of the reference's
+    'BC-FL' — described only in ``README.md:10`` and MT notebook cells 26-28)."""
+
+    enabled: bool = False
+    use_native: bool = True  # C++ SHA-256 core if built, hashlib otherwise
+    # ledger-entry payload size (bytes) for communication accounting: the
+    # reference models the blockchain payload as 0.043 GB vs the 0.4036 GB
+    # full model (MT notebook cell 27 vs 23)
+    entry_payload_bytes: int = 46_170_898  # 0.043 GiB-class default
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    # --- experiment identity ---
+    name: str = "fed"
+    seed: int = 42  # reference seeds dataset shuffle with 42 (server_IID_IMDB.py:68)
+
+    # --- data ---
+    dataset: str = "synthetic"  # key into bcfl_tpu.data.datasets registry
+    text_col: str = "text"
+    label_col: str = "labels"
+    num_labels: int = 2
+    seq_len: int = 128
+    batch_size: int = 32  # reference: batch_size=32 (server_IID_IMDB.py:96-99)
+    vocab_size: int = 8192  # hash-tokenizer vocab (HF tokenizers override this)
+    tokenizer: str = "hash"  # "hash" | HF tokenizer name
+
+    # --- model ---
+    model: str = "tiny-bert"  # key into bcfl_tpu.models registry
+    hf_checkpoint: Optional[str] = None  # e.g. "albert-base-v2" to import weights
+    lora_rank: int = 0  # 0 = full fine-tune (reference behaviour); >0 = LoRA
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    # --- federated topology ---
+    mode: str = "server"  # "server" (centralized FedAvg) | "serverless" (P2P gossip)
+    sync: str = "sync"  # "sync" | "async" (host-scheduled, staleness-weighted)
+    num_clients: int = 4
+    num_rounds: int = 2
+    local_epochs: int = 1  # reference: 1 epoch per round (server_IID_IMDB.py:172)
+    max_local_batches: Optional[int] = None  # cap scan length (static shape)
+    # True  = example-weighted FedAvg (Flower's aggregate, server mode)
+    # False = unweighted mean (reference serverless ":296" semantics)
+    weighted_agg: bool = True
+    # faithful=True reproduces the reference serverless quirk where clients
+    # sequentially mutate ONE shared model within a round
+    # (serverless_NonIID_IMDB.py:288 — see SURVEY.md §3.2)
+    faithful: bool = False
+
+    # --- optimizer (reference: fresh AdamW lr=5e-5 each round, server_IID_IMDB.py:109) ---
+    learning_rate: float = 5e-5
+    optimizer: str = "adamw"
+    max_grad_norm: float = 0.0  # 0 = off (reference has no clipping)
+
+    # --- async scheduling ---
+    async_buffer: int = 0  # aggregate when this many clients arrived (0 = num_clients)
+    staleness_decay: float = 0.5  # weight = decay ** staleness
+
+    # --- sub-configs ---
+    partition: PartitionConfig = dataclasses.field(default_factory=PartitionConfig)
+    topology: TopologyConfig = dataclasses.field(default_factory=TopologyConfig)
+    ledger: LedgerConfig = dataclasses.field(default_factory=LedgerConfig)
+
+    # --- checkpoint / metrics ---
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0  # rounds; 0 = off
+    eval_every: int = 1
+
+    def __post_init__(self):
+        if self.mode not in ("server", "serverless"):
+            raise ValueError(f"unknown mode: {self.mode!r}")
+        if self.sync not in ("sync", "async"):
+            raise ValueError(f"unknown sync: {self.sync!r}")
+        if self.num_clients < 1 or self.num_rounds < 1:
+            raise ValueError("num_clients and num_rounds must be >= 1")
+
+    def replace(self, **kw) -> "FedConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,2 @@
+from bcfl_tpu.core.mesh import ClientMesh, client_mesh  # noqa: F401
+from bcfl_tpu.core.prng import client_round_keys, fold_round  # noqa: F401

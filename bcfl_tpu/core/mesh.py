@@ -1,0 +1,90 @@
+"""Device mesh with a ``clients`` axis — the spine of the framework.
+
+The reference parallelizes clients with Ray actors (server mode,
+``src/Servercase/server_IID_IMDB.py:211-218`` — effectively serialized, since
+``ray_init_args={"num_cpus": 1}``) or a plain Python loop (serverless mode,
+``src/Serverlesscase/serverless_NonIID_IMDB.py:286``). Here clients live on a
+``jax.sharding.Mesh`` axis: per-client params/opt-state/batches carry a leading
+client dimension sharded across the axis, and a whole federated round — every
+client's local training plus the aggregation collective — is ONE compiled XLA
+program. With fewer devices than clients, each device vmaps a stack of clients.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    for d in range(min(n, cap), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientMesh:
+    """A 1-D mesh over ``n_devices`` devices hosting ``num_clients`` clients.
+
+    ``per_device`` clients are stacked on each device (leading array dim);
+    collectives over :data:`CLIENT_AXIS` combine across devices, a reduction
+    over the stacked dim combines within a device.
+    """
+
+    mesh: Mesh
+    num_clients: int
+    per_device: int
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def axis(self) -> str:
+        return CLIENT_AXIS
+
+    def client_sharding(self) -> NamedSharding:
+        """Sharding for arrays with a leading (num_clients-sized) client dim."""
+        return NamedSharding(self.mesh, P(CLIENT_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_clients(self, tree):
+        """Device-put a pytree whose leaves all have leading dim num_clients."""
+        return jax.device_put(tree, self.client_sharding())
+
+    def replicate(self, tree):
+        return jax.device_put(tree, self.replicated())
+
+    def global_client_ids(self) -> np.ndarray:
+        """[num_clients] array mapping stacked order -> global client id.
+
+        Layout is device-major: device d holds clients
+        ``[d*per_device, (d+1)*per_device)``.
+        """
+        return np.arange(self.num_clients)
+
+
+def client_mesh(
+    num_clients: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> ClientMesh:
+    """Build the clients mesh.
+
+    Uses the largest divisor of ``num_clients`` that fits the available device
+    count, so any client count runs on any device count (num_clients=10 on 8
+    CPU devices -> 5 mesh devices x 2 stacked clients; 32 clients on a v5e-32
+    -> 1 client per chip, the BASELINE.json north star).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d = _largest_divisor_leq(num_clients, len(devices))
+    mesh = Mesh(np.array(devices[:d]), (CLIENT_AXIS,))
+    return ClientMesh(mesh=mesh, num_clients=num_clients, per_device=num_clients // d)

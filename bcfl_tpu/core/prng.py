@@ -1,0 +1,23 @@
+"""Deterministic PRNG threading.
+
+The reference seeds dataset shuffles (``seed=42``,
+``src/Servercase/server_IID_IMDB.py:68``) but draws client subsets with an
+unseeded ``random.sample`` (``:79-80``), so runs are not reproducible. Here one
+root key is folded per (round, client) so every sampling decision is
+deterministic and independent across clients and rounds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_round(key: jax.Array, round_idx: int) -> jax.Array:
+    return jax.random.fold_in(key, round_idx)
+
+
+def client_round_keys(key: jax.Array, num_clients: int, round_idx: int) -> jax.Array:
+    """[num_clients, 2] stacked keys, one per client, distinct per round."""
+    rk = fold_round(key, round_idx)
+    return jax.vmap(lambda c: jax.random.fold_in(rk, c))(jnp.arange(num_clients))

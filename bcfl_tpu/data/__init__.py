@@ -1,0 +1,8 @@
+from bcfl_tpu.data.tokenizer import HashTokenizer, get_tokenizer  # noqa: F401
+from bcfl_tpu.data.partition import (  # noqa: F401
+    iid_indices,
+    contiguous_indices,
+    Partitioner,
+)
+from bcfl_tpu.data.datasets import TextDataset, load_dataset, register_dataset  # noqa: F401
+from bcfl_tpu.data.pipeline import TokenCache, client_batches  # noqa: F401

@@ -1,0 +1,131 @@
+"""Tokenize-once cache + static-shape federated batch stacks.
+
+Fixes the reference's biggest data-path waste: serverless mode re-tokenizes the
+ENTIRE dataset once per client per round (``load_data_clients`` called inside
+the round loop, ``src/Serverlesscase/serverless_NonIID_IMDB.py:287`` — 200 full
+passes for 10 clients x 20 rounds). Here the corpus is tokenized exactly once
+into ``[N, seq_len]`` int32 arrays; per-(client, round) selection is pure
+index gather.
+
+Batch stacks are fully static-shaped for XLA: a round's training input is one
+``[num_clients, steps, batch, seq_len]`` array (sharded over the clients mesh
+axis), where ``steps`` is fixed across clients; clients with fewer examples
+wrap around (the per-example loss mask keeps metrics honest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from bcfl_tpu.data.datasets import TextDataset
+from bcfl_tpu.data.partition import Partitioner
+
+
+@dataclasses.dataclass
+class TokenCache:
+    """One-shot tokenization of a :class:`TextDataset`."""
+
+    train_ids: np.ndarray  # [N_train, L] int32
+    train_mask: np.ndarray
+    train_labels: np.ndarray  # [N_train] int32
+    test_ids: np.ndarray
+    test_mask: np.ndarray
+    test_labels: np.ndarray
+    num_labels: int
+
+    @classmethod
+    def build(cls, ds: TextDataset, tokenizer, seq_len: int) -> "TokenCache":
+        tr_ids, tr_mask = tokenizer.encode_batch(ds.train_texts, seq_len)
+        te_ids, te_mask = tokenizer.encode_batch(ds.test_texts, seq_len)
+        return cls(tr_ids, tr_mask, ds.train_labels, te_ids, te_mask, ds.test_labels,
+                   ds.num_labels)
+
+
+def _gather_batches(ids, mask, labels, idx: np.ndarray, batch: int, steps: int):
+    """[steps*batch] indices (wrapping) -> ids/mask/labels/example-mask stacks."""
+    need = steps * batch
+    if idx.size == 0:
+        idx = np.zeros((1,), dtype=np.int64)
+        valid = np.zeros((need,), dtype=np.float32)
+    else:
+        valid = (np.arange(need) < idx.size).astype(np.float32)
+    take = idx[np.arange(need) % idx.size]
+    shape = (steps, batch)
+    return (
+        ids[take].reshape(shape + ids.shape[1:]),
+        mask[take].reshape(shape + mask.shape[1:]),
+        labels[take].reshape(shape),
+        valid.reshape(shape),
+    )
+
+
+def client_batches(
+    cache: TokenCache,
+    part: Partitioner,
+    num_clients: int,
+    round_idx: int,
+    batch_size: int,
+    max_batches: Optional[int] = None,
+    split: str = "train",
+) -> Tuple[dict, np.ndarray]:
+    """Build the round's stacked per-client batches.
+
+    Returns ``(batch_tree, num_examples)`` where ``batch_tree`` leaves are
+    ``[num_clients, steps, batch, ...]`` numpy arrays (``ids``, ``mask``,
+    ``labels``, ``example_mask``) and ``num_examples[c]`` is the true example
+    count per client (the FedAvg weighting the Flower strategy uses —
+    ``weighted_average``, ``src/Servercase/server_IID_IMDB.py:199-204``).
+    """
+    if split == "train":
+        ids, mask, labels = cache.train_ids, cache.train_mask, cache.train_labels
+    else:
+        ids, mask, labels = cache.test_ids, cache.test_mask, cache.test_labels
+
+    per_client_idx = []
+    for c in range(num_clients):
+        tr, te = part.train_test_indices(c, round_idx)
+        per_client_idx.append(tr if split == "train" else te)
+
+    sizes = [max(i.size, 1) for i in per_client_idx]
+    steps = int(np.ceil(max(sizes) / batch_size))
+    if max_batches is not None:
+        steps = min(steps, max_batches)
+    steps = max(steps, 1)
+
+    out_ids, out_mask, out_labels, out_emask = [], [], [], []
+    n_examples = np.zeros((num_clients,), dtype=np.float32)
+    for c, idx in enumerate(per_client_idx):
+        n_examples[c] = idx.size
+        b_ids, b_mask, b_labels, b_emask = _gather_batches(
+            ids, mask, labels, idx, batch_size, steps
+        )
+        out_ids.append(b_ids)
+        out_mask.append(b_mask)
+        out_labels.append(b_labels)
+        out_emask.append(b_emask)
+
+    tree = {
+        "ids": np.stack(out_ids),
+        "mask": np.stack(out_mask),
+        "labels": np.stack(out_labels),
+        "example_mask": np.stack(out_emask),
+    }
+    return tree, n_examples
+
+
+def central_eval_batches(cache: TokenCache, batch_size: int, max_batches: Optional[int] = None):
+    """Whole-test-set batches for global-model evaluation (reference:
+    ``evaluate_global_model`` on a fresh IID loader,
+    ``serverless_IID_IMDB.py:232-249``)."""
+    n = cache.test_ids.shape[0]
+    steps = int(np.ceil(n / batch_size))
+    if max_batches is not None:
+        steps = min(steps, max_batches)
+    idx = np.arange(n)
+    ids, mask, labels, emask = _gather_batches(
+        cache.test_ids, cache.test_mask, cache.test_labels, idx, batch_size, steps
+    )
+    return {"ids": ids, "mask": mask, "labels": labels, "example_mask": emask}

@@ -1,0 +1,102 @@
+"""Tokenization with TPU-friendly static shapes.
+
+The reference tokenizes with HF ``AutoTokenizer`` + per-batch dynamic padding
+(``DataCollatorWithPadding``, ``src/Servercase/server_IID_IMDB.py:96-99``) —
+and re-tokenizes the full dataset once per client per round in serverless mode
+(``serverless_NonIID_IMDB.py:287`` calls ``load_data_clients`` inside the round
+loop: 200 full tokenization passes per run — see SURVEY.md §3.2). Dynamic
+padding is hostile to XLA (every batch shape recompiles), so here:
+
+- tokenize ONCE into a cached ``[N, seq_len]`` int32 array + mask,
+- pad/truncate to a fixed ``seq_len`` (reference truncates at the model max of
+  512 anyway; one variant attempts ``max_length=500``,
+  ``Serverless_NonIID_Medical_transcriptions.py:83``).
+
+Two tokenizers:
+
+- :class:`HashTokenizer` — dependency-free deterministic whitespace+hash
+  word tokenizer. Used offline (no HF hub egress) and in tests/benches.
+- HF tokenizers via :func:`get_tokenizer` when a pretrained vocab is
+  available locally, for checkpoint-faithful runs.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+PAD_ID = 0
+UNK_ID = 1
+CLS_ID = 2
+SEP_ID = 3
+N_SPECIAL = 4
+
+_WORD_RE = re.compile(r"[a-z0-9']+|[^\sa-z0-9']")
+
+
+class HashTokenizer:
+    """Deterministic hashing word tokenizer (feature-hashing vocab).
+
+    No trained vocab file is needed: token id = crc32(word) % (vocab - 4) + 4.
+    Collisions are benign at the classification fidelity the reference targets
+    and the mapping is stable across processes/hosts (crc32, not Python hash).
+    """
+
+    def __init__(self, vocab_size: int = 8192):
+        if vocab_size <= N_SPECIAL:
+            raise ValueError("vocab_size too small")
+        self.vocab_size = vocab_size
+
+    def _word_id(self, w: str) -> int:
+        return zlib.crc32(w.encode("utf-8")) % (self.vocab_size - N_SPECIAL) + N_SPECIAL
+
+    def encode(self, text: str, seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        words = _WORD_RE.findall(text.lower())
+        ids = ([CLS_ID] + [self._word_id(w) for w in words[: max(seq_len - 2, 0)]] + [SEP_ID])[
+            :seq_len
+        ]
+        n = len(ids)
+        out = np.full((seq_len,), PAD_ID, dtype=np.int32)
+        out[:n] = ids
+        mask = np.zeros((seq_len,), dtype=np.int32)
+        mask[:n] = 1
+        return out, mask
+
+    def encode_batch(self, texts: Sequence[str], seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        ids = np.empty((len(texts), seq_len), dtype=np.int32)
+        mask = np.empty((len(texts), seq_len), dtype=np.int32)
+        for i, t in enumerate(texts):
+            ids[i], mask[i] = self.encode(t, seq_len)
+        return ids, mask
+
+
+class HFTokenizerAdapter:
+    """Wraps a HF fast tokenizer into the fixed-shape interface."""
+
+    def __init__(self, name: str):
+        from transformers import AutoTokenizer  # local import: optional dep
+
+        self._tok = AutoTokenizer.from_pretrained(name)
+        # len() includes added/special tokens; .vocab_size does not, and ids
+        # can exceed it -> silent OOB-clamped embedding gathers on TPU
+        self.vocab_size = len(self._tok)
+
+    def encode_batch(self, texts: Sequence[str], seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        enc = self._tok(
+            list(texts),
+            truncation=True,
+            max_length=seq_len,
+            padding="max_length",
+            return_tensors="np",
+        )
+        return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+
+def get_tokenizer(name: str, vocab_size: int = 8192):
+    """``"hash"`` -> :class:`HashTokenizer`; anything else -> HF tokenizer."""
+    if name == "hash":
+        return HashTokenizer(vocab_size)
+    return HFTokenizerAdapter(name)

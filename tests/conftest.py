@@ -1,0 +1,21 @@
+"""Test harness: force an 8-device CPU mesh so every collective
+(psum FedAvg, ppermute gossip) is exercised exactly as on a TPU pod —
+the distributed-without-hardware strategy from SURVEY.md §4.
+
+jax may already be imported at interpreter start (site hooks), so env vars
+alone are too late — set the config directly before any backend initializes.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

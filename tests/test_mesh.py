@@ -1,0 +1,35 @@
+import jax
+import numpy as np
+
+from bcfl_tpu.core import client_mesh, client_round_keys
+
+
+def test_mesh_divisor_layouts():
+    # 8 CPU devices forced by conftest
+    assert len(jax.devices()) == 8
+    m = client_mesh(8)
+    assert m.n_devices == 8 and m.per_device == 1
+    m = client_mesh(10)  # 10 clients on 8 devices -> 5 devices x 2 stacked
+    assert m.n_devices == 5 and m.per_device == 2
+    m = client_mesh(3)
+    assert m.n_devices == 3 and m.per_device == 1
+    m = client_mesh(16)
+    assert m.n_devices == 8 and m.per_device == 2
+
+
+def test_shard_clients_places_leading_dim():
+    m = client_mesh(8)
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    xs = m.shard_clients(x)
+    assert xs.sharding.spec == jax.sharding.PartitionSpec("clients")
+
+
+def test_client_round_keys_distinct():
+    keys = client_round_keys(jax.random.key(0), 4, round_idx=0)
+    assert keys.shape[0] == 4
+    flat = np.asarray(jax.random.key_data(keys)).reshape(4, -1)
+    assert len({tuple(r) for r in flat.tolist()}) == 4
+    keys2 = client_round_keys(jax.random.key(0), 4, round_idx=1)
+    assert not np.array_equal(
+        np.asarray(jax.random.key_data(keys)), np.asarray(jax.random.key_data(keys2))
+    )

@@ -1,0 +1,51 @@
+import jax
+import numpy as np
+
+from bcfl_tpu.config import PartitionConfig
+from bcfl_tpu.data.partition import Partitioner, contiguous_indices, iid_indices
+
+
+def test_iid_deterministic_and_disjoint_keys():
+    key = jax.random.key(0)
+    a = iid_indices(key, 1000, 100)
+    b = iid_indices(key, 1000, 100)
+    np.testing.assert_array_equal(a, b)
+    assert len(set(a.tolist())) == 100  # without replacement
+    c = iid_indices(jax.random.fold_in(key, 1), 1000, 100)
+    assert not np.array_equal(a, c)
+
+
+def test_contiguous_imdb_schedule():
+    # the 300k/240 IMDB schedule (serverless_NonIID_IMDB.py:59-60)
+    for k in range(5):
+        train, test = contiguous_indices(k, 300, 240, 60, 25000, 25000, "trailing")
+        assert train[0] == 300 * k and train[-1] == 300 * k + 239
+        assert test[0] == 300 * k + 240 and test[-1] == 300 * (k + 1) - 1
+
+
+def test_contiguous_medical_schedule_fixed_test():
+    # the 500i/400 medical schedule (Serverless_NonIID_Medical_transcriptions.py:55-56)
+    for i in range(3):
+        train, test = contiguous_indices(i, 500, 400, 400, 12021, 3003, "fixed")
+        assert train[0] == 500 * i and train.size == 400
+        np.testing.assert_array_equal(test, np.arange(400))
+
+
+def test_contiguous_clips_and_wraps():
+    train, test = contiguous_indices(100, 300, 240, 60, 1000, 1000, "trailing")
+    assert train.size > 0 and train.max() < 1000
+    assert test.size == 0 or test.max() < 1000
+
+
+def test_partitioner_resample_each_round():
+    cfg = PartitionConfig(kind="iid", iid_samples=50, resample_each_round=True)
+    p = Partitioner(cfg, 1000, 1000, jax.random.key(7))
+    t0, _ = p.train_test_indices(0, 0)
+    t1, _ = p.train_test_indices(0, 1)
+    assert not np.array_equal(t0, t1)
+
+    cfg2 = PartitionConfig(kind="iid", iid_samples=50, resample_each_round=False)
+    p2 = Partitioner(cfg2, 1000, 1000, jax.random.key(7))
+    s0, _ = p2.train_test_indices(0, 0)
+    s1, _ = p2.train_test_indices(0, 1)
+    np.testing.assert_array_equal(s0, s1)

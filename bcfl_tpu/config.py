@@ -47,6 +47,18 @@ class PartitionConfig:
             raise ValueError(f"unknown partition kind: {self.kind!r}")
         if self.test_mode not in ("trailing", "fixed"):
             raise ValueError(f"unknown test_mode: {self.test_mode!r}")
+        if self.kind == "contiguous":
+            if self.train_span > self.stride:
+                raise ValueError(
+                    f"train_span {self.train_span} > stride {self.stride}: "
+                    "client slices would overlap"
+                )
+            if self.test_mode == "trailing" and self.train_span + self.test_span > self.stride:
+                raise ValueError(
+                    f"train_span+test_span {self.train_span + self.test_span} > "
+                    f"stride {self.stride}: trailing test slice would overlap the "
+                    "next client's train slice"
+                )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -25,7 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Callable, Dict, List, Optional, Sequence
+import warnings
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -202,6 +203,14 @@ def _load_hf_or_synthetic(name: str, *, text_col: str, label_col: str,
     try:
         return _load_hf(name, text_col=text_col, label_col=label_col,
                         num_labels=num_labels, alias=alias, seed=seed)
-    except Exception:
-        # zero-egress environment: deterministic stand-in, same label space
-        return _synthetic(num_labels=num_labels, seed=seed, name=alias or name)
+    except Exception as e:
+        # zero-egress environment: deterministic stand-in, same label space.
+        # Loud and distinguishable — the name carries the stand-in marker so a
+        # run can never silently report hub-dataset accuracy on filler text.
+        warnings.warn(
+            f"could not load HF dataset {name!r} ({type(e).__name__}: {e}); "
+            "using a deterministic synthetic stand-in with the same label space",
+            stacklevel=2,
+        )
+        return _synthetic(num_labels=num_labels, seed=seed,
+                          name=f"{alias or name}:synthetic-standin")

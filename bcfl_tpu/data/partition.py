@@ -60,7 +60,7 @@ def contiguous_indices(
     """Reference Non-IID slice arithmetic, clipped to each split's length."""
     train = _clip_or_wrap(stride * client, train_span, n_train)
     if test_mode == "trailing":
-        test = _clip_or_wrap(stride * client + train_span, stride - train_span, n_test)
+        test = _clip_or_wrap(stride * client + train_span, test_span, n_test)
     else:  # fixed shared test slice
         test = np.arange(0, min(test_span, n_test))
     return train, test

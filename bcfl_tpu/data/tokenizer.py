@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import re
 import zlib
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 

@@ -1,0 +1,1 @@
+from bcfl_tpu.fed.client_step import FedPrograms, build_programs  # noqa: F401

@@ -1,0 +1,237 @@
+"""The compiled federated round: every client's local fine-tune + the
+aggregation collective in ONE XLA program.
+
+Reference equivalents (SURVEY.md §3):
+
+- local step (hot loop): 1-epoch AdamW lr=5e-5 full fine-tune, fresh optimizer
+  per round — ``train``, ``src/Servercase/server_IID_IMDB.py:108-118`` and
+  ``IMDBClient.train_model``, ``serverless_NonIID_IMDB.py:188-199``. Here it is
+  a ``lax.scan`` over static-shape batches, vmapped over the stacked clients of
+  each device, ``shard_map``-ped over the mesh.
+- server aggregation: Flower FedAvg (``server_IID_IMDB.py:205-218``) ->
+  :func:`bcfl_tpu.parallel.masked_weighted_mean` (psum).
+- serverless aggregation: all-client unweighted mean
+  (``serverless_NonIID_IMDB.py:296``) -> masked ring gossip
+  (:func:`bcfl_tpu.parallel.gossip_mix`, ppermute) or exact mean when
+  ``gossip_steps == 0``.
+
+Trainable tree is either the full param tree (reference behaviour) or a LoRA
+adapter tree over a frozen base (``frozen``), chosen by the engine; the round
+program is identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from bcfl_tpu.core.mesh import ClientMesh
+from bcfl_tpu.models import lora as lora_lib
+from bcfl_tpu.parallel.collectives import gossip_mix, masked_weighted_mean
+
+Tree = Any
+
+
+def make_optimizer(name: str, lr: float, max_grad_norm: float = 0.0):
+    """Reference: fresh ``AdamW(lr=5e-5)`` torch defaults each round
+    (``server_IID_IMDB.py:109``); torch AdamW weight_decay default is 0.01."""
+    if name == "adamw":
+        tx = optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    elif name == "sgd":
+        tx = optax.sgd(lr)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if max_grad_norm and max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx
+
+
+def _merge(trainable: Tree, frozen: Optional[Tree]) -> Tree:
+    """Full fine-tune: trainable IS the param tree. LoRA: merge adapters into
+    the frozen base."""
+    if frozen is None:
+        return trainable
+    return lora_lib.apply_lora(frozen, trainable)
+
+
+def make_loss_fn(model) -> Callable:
+    def loss_fn(trainable, frozen, batch, rng):
+        params = _merge(trainable, frozen)
+        logits = model.apply(
+            {"params": params}, batch["ids"], batch["mask"],
+            deterministic=rng is None,
+            rngs=None if rng is None else {"dropout": rng},
+        )
+        labels = batch["labels"]
+        ex = batch["example_mask"].astype(jnp.float32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        n = jnp.maximum(ex.sum(), 1.0)
+        loss = (per_ex * ex).sum() / n
+        correct = ((jnp.argmax(logits, -1) == labels).astype(jnp.float32) * ex).sum()
+        return loss, (correct, ex.sum())
+
+    return loss_fn
+
+
+@dataclasses.dataclass
+class FedPrograms:
+    """Compiled round/eval programs bound to one (model, mesh, optimizer)."""
+
+    mesh: ClientMesh
+    server_round: Callable  # (global_t, frozen, batches, weights, rngs) -> (global_t, metrics)
+    gossip_round: Callable  # (client_t, frozen, batches, mask, rngs) -> (client_t, metrics)
+    eval_clients: Callable  # (client_t_or_global, frozen, batches, stacked: bool) -> metrics
+    eval_global: Callable  # (trainable, frozen, batches) -> (loss, acc)
+    broadcast: Callable  # global_t -> stacked client_t [C, ...]
+    collapse: Callable  # stacked client_t, weights -> global mean
+
+
+def build_programs(
+    model,
+    mesh: ClientMesh,
+    optimizer: str = "adamw",
+    learning_rate: float = 5e-5,
+    max_grad_norm: float = 0.0,
+    gossip_alpha: float = 0.5,
+    gossip_steps: int = 1,
+    # donate=True deletes the caller's input param/opt buffers after each call
+    # (halves peak HBM for the round-chained engine); leave False if you reuse
+    # the input tree afterwards.
+    donate: bool = False,
+) -> FedPrograms:
+    tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
+    loss_fn = make_loss_fn(model)
+    axis = mesh.axis
+    jmesh = mesh.mesh
+    repl = P()
+    shard = P("clients")
+
+    # ---- one client's local round: fresh opt state, scan over batches ----
+    def local_train(trainable, frozen, batches, rng):
+        opt_state = tx.init(trainable)
+        steps = batches["ids"].shape[0]
+        step_rngs = jax.random.split(rng, steps)
+
+        def step(carry, xs):
+            t, opt = carry
+            batch, r = xs
+            (loss, (correct, n)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                t, frozen, batch, r
+            )
+            updates, opt = tx.update(grads, opt, t)
+            t = optax.apply_updates(t, updates)
+            return (t, opt), jnp.stack([loss * n, correct, n])
+
+        (trainable, _), stats = lax.scan(step, (trainable, opt_state), (batches, step_rngs))
+        total = stats.sum(axis=0)  # [loss*n, correct, n]
+        return trainable, total
+
+    def _unstack_rng(r):
+        # rngs arrive as stacked key-data uint32 [..., 2]; rebuild typed keys
+        return jax.random.wrap_key_data(r)
+
+    # ---- server mode: everyone trains from the SAME global trainable ----
+    def server_shard(global_t, frozen, batches, weights, rngs):
+        def per_client(b, w, r):
+            return local_train(global_t, frozen, b, _unstack_rng(r))
+
+        new_t, stats = jax.vmap(per_client)(batches, weights, rngs)
+        # all-masked round -> keep the round's starting params, don't zero them
+        avg = masked_weighted_mean(new_t, weights, axis, fallback=global_t)
+        return avg, stats
+
+    server_round = jax.jit(
+        shard_map(
+            server_shard, mesh=jmesh,
+            in_specs=(repl, repl, shard, shard, shard),
+            out_specs=(repl, shard),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    # ---- serverless mode: per-client params persist, ring gossip after ----
+    def gossip_shard(client_t, frozen, batches, mask, rngs):
+        def per_client(t, b, r):
+            return local_train(t, frozen, b, _unstack_rng(r))
+
+        new_t, stats = jax.vmap(per_client)(client_t, batches, rngs)
+        if gossip_steps == 0:
+            # exact all-client mean, reference-faithful serverless aggregation
+            # (serverless_NonIID_IMDB.py:296): every client ends the round with
+            # the same (mask-weighted) average.
+            avg = masked_weighted_mean(new_t, mask, axis, fallback=client_t)
+            new_t = jax.tree.map(
+                lambda a, x: jnp.where(
+                    mask.reshape((-1,) + (1,) * (x.ndim - 1)) > 0,
+                    jnp.broadcast_to(a, x.shape), x),
+                avg, new_t,
+            )
+        else:
+            new_t = gossip_mix(new_t, mask, gossip_alpha, axis, steps=gossip_steps)
+        return new_t, stats
+
+    gossip_round = jax.jit(
+        shard_map(
+            gossip_shard, mesh=jmesh,
+            in_specs=(shard, repl, shard, shard, shard),
+            out_specs=(shard, shard),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    # ---- evaluation ----
+    def eval_one(trainable, frozen, batches):
+        def step(carry, batch):
+            loss, (correct, n) = loss_fn(trainable, frozen, batch, None)
+            return carry, jnp.stack([loss * n, correct, n])
+
+        _, stats = lax.scan(step, 0.0, batches)
+        return stats.sum(axis=0)
+
+    def eval_clients_shard(client_t, frozen, batches):
+        return jax.vmap(lambda t, b: eval_one(t, frozen, b))(client_t, batches)
+
+    eval_clients = jax.jit(
+        shard_map(
+            eval_clients_shard, mesh=jmesh,
+            in_specs=(shard, repl, shard),
+            out_specs=shard,
+            check_vma=False,
+        ),
+    )
+
+    eval_global = jax.jit(eval_one)
+
+    # ---- layout helpers ----
+    def broadcast(global_t):
+        return jax.device_put(
+            jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (mesh.num_clients,) + x.shape), global_t
+            ),
+            mesh.client_sharding(),
+        )
+
+    collapse = jax.jit(
+        shard_map(
+            lambda t, w: masked_weighted_mean(t, w, axis), mesh=jmesh,
+            in_specs=(shard, shard), out_specs=repl, check_vma=False,
+        )
+    )
+
+    return FedPrograms(
+        mesh=mesh,
+        server_round=server_round,
+        gossip_round=gossip_round,
+        eval_clients=eval_clients,
+        eval_global=eval_global,
+        broadcast=broadcast,
+        collapse=collapse,
+    )

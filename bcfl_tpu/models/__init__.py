@@ -1,0 +1,50 @@
+"""Model registry.
+
+The reference's model space is three HF checkpoints (SURVEY.md §2.1):
+``albert-base-v2``, ``dmis-lab/biobert-v1.1`` (cased BERT-base), used via
+``AutoModelForSequenceClassification``. Registry names map to
+:class:`~bcfl_tpu.models.bert.EncoderConfig` instances; ``tiny-*`` variants are
+the scale-down smoke models (the reference's de-facto test method is a
+NUM_CLIENTS=2/NUM_ROUNDS=2 scale-down of the same script — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+from bcfl_tpu.models.bert import EncoderConfig, TextClassifier  # noqa: F401
+from bcfl_tpu.models import lora  # noqa: F401
+
+_CONFIGS: Dict[str, EncoderConfig] = {
+    # test/bench scale-downs
+    "tiny-bert": EncoderConfig(vocab_size=8192, hidden_size=128, num_layers=2,
+                               num_heads=2, intermediate_size=512),
+    "tiny-albert": EncoderConfig(vocab_size=8192, hidden_size=128, num_layers=2,
+                                 num_heads=2, intermediate_size=512,
+                                 share_layers=True, embedding_size=64),
+    # BERT-base family (BASELINE.json north-star model; biobert-v1.1 is a
+    # cased BERT-base, vocab 28996 — reference server_IID_IMDB.py:48)
+    "bert-base": EncoderConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                               num_heads=12, intermediate_size=3072),
+    "biobert-base": EncoderConfig(vocab_size=28996, hidden_size=768, num_layers=12,
+                                  num_heads=12, intermediate_size=3072),
+    # albert-base-v2 (reference serverless_NonIID_IMDB.py:30)
+    "albert-base": EncoderConfig(vocab_size=30000, hidden_size=768, num_layers=12,
+                                 num_heads=12, intermediate_size=3072,
+                                 share_layers=True, embedding_size=128),
+}
+
+
+def get_config(name: str, **overrides) -> EncoderConfig:
+    if name not in _CONFIGS:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_CONFIGS)}")
+    return dataclasses.replace(_CONFIGS[name], **overrides)
+
+
+def list_models():
+    return sorted(_CONFIGS)
+
+
+def build(name: str, **overrides) -> TextClassifier:
+    return TextClassifier(get_config(name, **overrides))

@@ -1,0 +1,171 @@
+"""Flax BERT/ALBERT-family encoder + sequence-classification head.
+
+The reference's L1 model layer is ``AutoModelForSequenceClassification
+.from_pretrained(CHECKPOINT, num_labels=N)`` over three checkpoints:
+``albert-base-v2``, ``dmis-lab/biobert-v1.1`` (a cased BERT-base) — SURVEY.md
+§2.1. This module implements both architectures as ONE configurable Flax
+model, TPU-first:
+
+- post-LayerNorm transformer encoder (BERT formulation),
+- ALBERT = the same encoder with ``share_layers=True`` (one parameter set
+  applied ``num_layers`` times) + factorized embeddings
+  (``embedding_size < hidden_size``),
+- bf16 compute / f32 params by default (MXU-friendly),
+- static shapes everywhere; padding handled by an additive attention bias and
+  masked loss downstream,
+- attention via :func:`bcfl_tpu.ops.dot_product_attention` (einsum -> MXU) or
+  the Pallas flash kernel for long sequences.
+
+HF checkpoint weights import via :mod:`bcfl_tpu.models.hf_import`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from bcfl_tpu.ops.attention import attention_bias_from_mask, dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 8192
+    hidden_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 2
+    intermediate_size: int = 512
+    max_position: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+    share_layers: bool = False  # ALBERT-style cross-layer parameter sharing
+    embedding_size: Optional[int] = None  # ALBERT factorized embeddings; None = hidden
+    use_flash: bool = False  # Pallas blockwise attention for long sequences
+    dtype: jnp.dtype = jnp.bfloat16  # compute dtype
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        c = self.cfg
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(c.num_heads, c.head_dim),
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            name=name,
+        )
+        # [B, S, H, D] -> [B, H, S, D]
+        q = dense("query")(x).transpose(0, 2, 1, 3)
+        k = dense("key")(x).transpose(0, 2, 1, 3)
+        v = dense("value")(x).transpose(0, 2, 1, 3)
+        if c.use_flash and x.shape[1] >= 512:
+            from bcfl_tpu.ops.flash import flash_attention
+
+            out = flash_attention(q, k, v, bias)
+        else:
+            out = dot_product_attention(q, k, v, bias)
+        out = out.transpose(0, 2, 1, 3)  # [B, S, H, D]
+        out = nn.DenseGeneral(
+            features=self.cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            name="out",
+        )(out)
+        return nn.Dropout(c.dropout_rate)(out, deterministic=deterministic)
+
+
+class EncoderLayer(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        c = self.cfg
+        a = SelfAttention(c, name="attention")(x, bias, deterministic)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         param_dtype=c.param_dtype, name="attention_norm")(x + a)
+        h = nn.Dense(c.intermediate_size, dtype=c.dtype, param_dtype=c.param_dtype,
+                     name="mlp_in")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, param_dtype=c.param_dtype,
+                     name="mlp_out")(h)
+        h = nn.Dropout(c.dropout_rate)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                            param_dtype=c.param_dtype, name="mlp_norm")(x + h)
+
+
+class Embeddings(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, type_ids, deterministic: bool):
+        c = self.cfg
+        e = c.embedding_size or c.hidden_size
+        emb = nn.Embed(c.vocab_size, e, param_dtype=c.param_dtype, name="word")(ids)
+        pos = nn.Embed(c.max_position, e, param_dtype=c.param_dtype, name="position")(
+            jnp.arange(ids.shape[1])[None, :]
+        )
+        typ = nn.Embed(c.type_vocab_size, e, param_dtype=c.param_dtype, name="type")(type_ids)
+        x = (emb + pos + typ).astype(c.dtype)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         param_dtype=c.param_dtype, name="norm")(x)
+        x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+        if e != c.hidden_size:  # ALBERT factorized projection
+            x = nn.Dense(c.hidden_size, dtype=c.dtype, param_dtype=c.param_dtype,
+                         name="projection")(x)
+        return x
+
+
+class Encoder(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask, type_ids=None, deterministic: bool = True):
+        c = self.cfg
+        if type_ids is None:
+            type_ids = jnp.zeros_like(ids)
+        x = Embeddings(c, name="embeddings")(ids, type_ids, deterministic)
+        bias = attention_bias_from_mask(mask, dtype=jnp.float32)
+        if c.share_layers:
+            layer = EncoderLayer(c, name="layer_shared")
+            for _ in range(c.num_layers):
+                x = layer(x, bias, deterministic)
+        else:
+            for i in range(c.num_layers):
+                x = EncoderLayer(c, name=f"layer_{i}")(x, bias, deterministic)
+        return x
+
+
+class TextClassifier(nn.Module):
+    """Encoder + BERT-style pooler (tanh over [CLS]) + classification head.
+
+    Forward signature matches what the federated client step needs:
+    ``apply(params, ids, mask) -> [B, num_labels] float32 logits``.
+    """
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask, type_ids=None, deterministic: bool = True):
+        c = self.cfg
+        x = Encoder(c, name="encoder")(ids, mask, type_ids, deterministic)
+        cls = x[:, 0]
+        pooled = nn.tanh(
+            nn.Dense(c.hidden_size, dtype=c.dtype, param_dtype=c.param_dtype,
+                     name="pooler")(cls)
+        )
+        pooled = nn.Dropout(c.dropout_rate)(pooled, deterministic=deterministic)
+        logits = nn.Dense(c.num_labels, dtype=jnp.float32, param_dtype=c.param_dtype,
+                          name="classifier")(pooled)
+        return logits

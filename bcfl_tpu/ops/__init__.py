@@ -1,0 +1,1 @@
+from bcfl_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,47 @@
+"""Attention ops.
+
+The reference never touches attention directly — it calls prebuilt torch
+kernels inside HF models (SURVEY.md §2.3). Here attention is ours, built for
+the TPU compilation model:
+
+- :func:`dot_product_attention` — einsum formulation XLA fuses onto the MXU;
+  the default for the reference-scale seq lengths (<=512).
+- :mod:`bcfl_tpu.ops.flash` — a Pallas blockwise (flash) kernel for long
+  sequences, selected via ``use_flash`` in the model config.
+
+Shapes follow the TPU-friendly convention [batch, heads, seq, head_dim] with
+an additive mask/bias (0 for keep, large-negative for drop) so padding masks,
+causal masks, and ALiBi-style biases all ride the same operand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative instead of -inf: keeps softmax NaN-free for
+# fully-masked (all-padding) rows, which static-shape batches produce
+
+
+def attention_bias_from_mask(mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """[batch, seq] 0/1 padding mask -> [batch, 1, 1, seq] additive bias."""
+    return jnp.where(mask[:, None, None, :] > 0, 0.0, NEG_INF).astype(dtype)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, H, S, D]
+    k: jnp.ndarray,  # [B, H, S, D]
+    v: jnp.ndarray,  # [B, H, S, D]
+    bias: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, S, S]
+) -> jnp.ndarray:
+    """Plain softmax(QK^T/sqrt(d))V. Stable softmax in f32, output in q.dtype."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(depth, jnp.float32))
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    scores = scores - scores.max(axis=-1, keepdims=True)
+    probs = jnp.exp(scores)
+    probs = probs / (probs.sum(axis=-1, keepdims=True) + 1e-9)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)

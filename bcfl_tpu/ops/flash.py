@@ -1,0 +1,91 @@
+"""Blockwise (flash) attention for long sequences.
+
+Two implementations behind one signature:
+
+- :func:`flash_attention_xla` — pure-JAX blockwise online-softmax over KV
+  blocks via ``lax.scan``. O(S) memory in the sequence instead of the O(S^2)
+  score matrix; runs on any backend (and is the CPU-mesh test oracle).
+- :func:`flash_attention_pallas` — TPU Pallas kernel (see
+  ``/opt/skills/guides/pallas_guide.md``), used automatically on TPU backends
+  when shapes allow; falls back to the XLA version elsewhere.
+
+The reference never needed this (it truncates at 512 tokens — SURVEY.md §5
+"long-context: absent"), but long-context is first-class here: this is the
+building block that scales classification/fine-tuning past the HF tokenizer
+cap, and ring attention in :mod:`bcfl_tpu.parallel` composes it across chips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_BLOCK = 512
+
+
+def flash_attention_xla(
+    q: jnp.ndarray,  # [B, H, S, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, S, S]
+    block_size: int = DEFAULT_BLOCK,
+) -> jnp.ndarray:
+    """Online-softmax blockwise attention (Rabe & Staats / FlashAttention
+    recurrence), scanning KV blocks so the full score matrix never exists."""
+    B, H, S, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    nb = max(S // block_size, 1)
+    bs = S // nb
+    if S % nb:
+        # fall back to one block if the length doesn't tile evenly
+        nb, bs = 1, S
+
+    kb = k.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)  # [nb, B, H, bs, D]
+    vb = v.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
+    if bias is not None:
+        bias = jnp.broadcast_to(bias, (B, H, S, S)).astype(jnp.float32)
+        bb = bias.reshape(B, H, S, nb, bs).transpose(3, 0, 1, 2, 4)  # [nb, B, H, S, bs]
+    else:
+        bb = jnp.zeros((nb, 1, 1, 1, bs), jnp.float32)
+
+    qf = q.astype(jnp.float32) * scale
+
+    def step(carry, xs):
+        acc, m, l = carry  # acc [B,H,S,D] f32; m,l [B,H,S,1]
+        kj, vj, bj = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32)) + bj
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vj.astype(jnp.float32))
+        return (acc, m_new, l), None
+
+    init = (
+        jnp.zeros((B, H, S, D), jnp.float32),
+        jnp.full((B, H, S, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((B, H, S, 1), jnp.float32),
+    )
+    (acc, m, l), _ = lax.scan(step, init, (kb, vb, bb))
+    return (acc / jnp.maximum(l, 1e-9)).astype(q.dtype)
+
+
+def flash_attention_pallas(q, k, v, bias=None, block_q: int = 256, block_k: int = 256):
+    """TPU Pallas flash kernel; implemented in :mod:`bcfl_tpu.ops.pallas_flash`."""
+    from bcfl_tpu.ops.pallas_flash import flash_attention as _pl
+
+    return _pl(q, k, v, bias, block_q=block_q, block_k=block_k)
+
+
+def flash_attention(q, k, v, bias=None, block_size: int = DEFAULT_BLOCK):
+    """Dispatch: Pallas on TPU when available, XLA blockwise elsewhere."""
+    try:
+        if jax.default_backend() == "tpu":
+            return flash_attention_pallas(q, k, v, bias)
+    except Exception:
+        pass
+    return flash_attention_xla(q, k, v, bias, block_size=block_size)

@@ -1,0 +1,6 @@
+from bcfl_tpu.parallel.collectives import (  # noqa: F401
+    masked_weighted_mean,
+    ring_shift,
+    gossip_mix,
+    mix_with_matrix,
+)

@@ -1,0 +1,145 @@
+"""Aggregation and gossip collectives over the ``clients`` mesh axis.
+
+This is the framework's distributed communication backend — the TPU-native
+replacement for the reference's Flower-over-Ray parameter shipping (server
+mode, ``src/Servercase/server_IID_IMDB.py:211-218``) and its Python-list
+"weight transfer" (serverless mode, ``serverless_NonIID_IMDB.py:293-296``) —
+SURVEY.md §2.5:
+
+- FedAvg            -> masked weighted mean via ``jax.lax.psum`` (ICI/DCN)
+- P2P ring gossip   -> ``jax.lax.ppermute`` neighbor exchange + local mixing
+- arbitrary topology-> all_gather + mixing-matrix einsum
+
+All functions run INSIDE ``shard_map`` over :data:`bcfl_tpu.core.mesh.CLIENT_AXIS`:
+leaves carry a local stacked-client leading dim ``Cl = num_clients / n_devices``
+(device-major global order), reductions combine the local dim in-register and
+the device axis over the interconnect. Anomaly-gated aggregation keeps the
+mesh shape fixed: excluded clients keep computing but carry weight 0
+(SURVEY.md §7 "anomaly gating without reshaping the mesh").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Tree = Any
+EPS = 1e-12
+
+
+def _axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)
+
+
+def masked_weighted_mean(tree: Tree, weights: jnp.ndarray, axis_name: str,
+                         fallback: Optional[Tree] = None) -> Tree:
+    """Global weighted mean over all clients; ``weights`` [Cl] already folds
+    participation mask x (optionally) example counts.
+
+    weights = mask                  -> reference serverless unweighted mean
+              (``serverless_NonIID_IMDB.py:296``)
+    weights = mask * num_examples   -> Flower FedAvg example weighting
+              (``server_IID_IMDB.py:199-204``)
+
+    If EVERY client is masked out (an anomaly filter can do that on a bad
+    round) the mean is undefined; rather than silently zeroing the model we
+    return ``fallback`` (e.g. the round's starting params). With no fallback,
+    an unweighted mean of the tree is returned.
+    """
+    den = lax.psum(weights.sum(), axis_name)
+    n = lax.psum(jnp.asarray(weights.shape[0], jnp.float32), axis_name)
+    empty = den <= EPS
+
+    def leaf_mean(x, fb):
+        w = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        local = (w * x).sum(axis=0)
+        mean = lax.psum(local, axis_name) / jnp.maximum(den, EPS).astype(x.dtype)
+        if fb is None:
+            fb = lax.psum(x.sum(axis=0), axis_name) / n.astype(x.dtype)
+        return jnp.where(empty, fb, mean)
+
+    if fallback is None:
+        return jax.tree.map(lambda x: leaf_mean(x, None), tree)
+    return jax.tree.map(leaf_mean, tree, fallback)
+
+
+def ring_shift(tree: Tree, axis_name: str, direction: int = +1) -> Tree:
+    """Value of each client's ring neighbor, over the GLOBAL client order.
+
+    ``direction=+1``: client ``i`` receives client ``(i+1) mod C``'s value;
+    ``direction=-1``: from ``(i-1) mod C``. Locally a roll of the stacked dim;
+    the boundary element rides one ``ppermute`` hop over ICI.
+    """
+    if direction not in (+1, -1):
+        raise ValueError("direction must be +1 or -1")
+    d = _axis_size(axis_name)
+
+    def shift(x):
+        if direction == +1:
+            rolled = jnp.roll(x, -1, axis=0)
+            # next device's first local client -> my last local slot
+            perm = [(i, (i - 1) % d) for i in range(d)]
+            incoming = lax.ppermute(x[:1], axis_name, perm)
+            return rolled.at[-1:].set(incoming)
+        rolled = jnp.roll(x, 1, axis=0)
+        # previous device's last local client -> my first local slot
+        perm = [(i, (i + 1) % d) for i in range(d)]
+        incoming = lax.ppermute(x[-1:], axis_name, perm)
+        return rolled.at[:1].set(incoming)
+
+    return jax.tree.map(shift, tree)
+
+
+def gossip_mix(tree: Tree, mask: jnp.ndarray, alpha: float, axis_name: str,
+               steps: int = 1) -> Tree:
+    """Symmetric masked ring gossip: each client averages toward its two ring
+    neighbors. With mixing weight ``alpha`` and participation ``mask`` [Cl]:
+
+        x_i <- x_i + (alpha/2) * m_{i-1} (x_{i-1} - x_i)
+                   + (alpha/2) * m_{i+1} (x_{i+1} - x_i)
+
+    Anomalous neighbors (mask 0) contribute nothing, and an anomalous client
+    still hears from honest neighbors only through its own mask: if client i
+    itself is masked out we freeze it entirely so its (possibly poisoned)
+    state neither spreads nor drifts. Repeated ``steps`` diffuse toward the
+    global average — the intended semantics of the reference's all-client
+    averaging (``serverless_NonIID_IMDB.py:296``) without any all-to-all.
+    """
+    # neighbor masks are loop-invariant: two ppermutes total, not two per step
+    (m_left,) = jax.tree.leaves(ring_shift({"m": mask}, axis_name, -1))
+    (m_right,) = jax.tree.leaves(ring_shift({"m": mask}, axis_name, +1))
+    for _ in range(steps):
+        left = ring_shift(tree, axis_name, direction=-1)
+        right = ring_shift(tree, axis_name, direction=+1)
+
+        def mix(x, xl, xr):
+            ml = m_left.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+            mr = m_right.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+            me = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+            mixed = x + (alpha / 2) * ml * (xl - x) + (alpha / 2) * mr * (xr - x)
+            return me * mixed + (1 - me) * x
+
+        tree = jax.tree.map(mix, tree, left, right)
+    return tree
+
+
+def mix_with_matrix(tree: Tree, W: jnp.ndarray, axis_name: str,
+                    per_device: int) -> Tree:
+    """General topology mixing: ``x_i <- sum_j W[i, j] x_j`` for an arbitrary
+    (e.g. bandwidth-derived Metropolis) ``C x C`` mixing matrix.
+
+    Implemented as all_gather along the clients axis + one einsum — the
+    all-to-all path; prefer :func:`gossip_mix` at scale. Each device returns
+    only its local row block (device-major order).
+    """
+    idx = lax.axis_index(axis_name)
+
+    def mix(x):
+        full = lax.all_gather(x, axis_name, tiled=True)  # [C, ...]
+        mixed = jnp.einsum("ij,j...->i...", W.astype(x.dtype), full)
+        return lax.dynamic_slice_in_dim(mixed, idx * per_device, per_device, axis=0)
+
+    return jax.tree.map(mix, tree)

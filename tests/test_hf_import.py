@@ -1,0 +1,72 @@
+"""Parity of the HF importer against locally-constructed tiny torch models
+(no hub egress needed: HF models are built from configs with random init)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from bcfl_tpu.models.bert import TextClassifier  # noqa: E402
+from bcfl_tpu.models.hf_import import config_from_hf, import_state_dict  # noqa: E402
+
+
+def _parity(hf_model, atol):
+    cfg = config_from_hf(hf_model.config)
+    params = import_state_dict(hf_model.state_dict(), cfg)
+    model = TextClassifier(cfg.__class__(**{**cfg.__dict__, "dtype": jnp.float32}))
+
+    B, L = 2, 12
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(B, L))
+    mask = np.ones((B, L), dtype=np.int64)
+    mask[1, 8:] = 0
+
+    hf_model.eval()
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+        ).logits.numpy()
+
+    ours = np.asarray(
+        model.apply(params, jnp.asarray(ids, jnp.int32), jnp.asarray(mask, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=atol, rtol=1e-3)
+
+
+def test_bert_parity():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, max_position_embeddings=32, num_labels=3,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    _parity(transformers.BertForSequenceClassification(hf_cfg), atol=2e-4)
+
+
+def test_albert_parity():
+    hf_cfg = transformers.AlbertConfig(
+        vocab_size=120, embedding_size=16, hidden_size=32, num_hidden_layers=3,
+        num_attention_heads=2, intermediate_size=64, max_position_embeddings=32,
+        num_labels=4, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        classifier_dropout_prob=0.0,
+    )
+    _parity(transformers.AlbertForSequenceClassification(hf_cfg), atol=2e-4)
+
+
+def test_num_labels_mismatch_hard_errors():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=50, hidden_size=16, num_hidden_layers=1, num_attention_heads=2,
+        intermediate_size=32, max_position_embeddings=16, num_labels=3,
+    )
+    m = transformers.BertForSequenceClassification(hf_cfg)
+    cfg = config_from_hf(m.config, num_labels=41)
+    # the reference ships exactly this bug silently
+    # (serverless_cancer_biobert_allclients.py:117 three labels vs :242 forty-one)
+    with pytest.raises(ValueError, match="reinit_classifier"):
+        import_state_dict(m.state_dict(), cfg)
+    tree = import_state_dict(m.state_dict(), cfg, reinit_classifier=True)
+    assert tree["params"]["classifier"]["kernel"].shape == (16, 41)

@@ -1,0 +1,84 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcfl_tpu.models import build, get_config, list_models
+from bcfl_tpu.models.bert import TextClassifier
+from bcfl_tpu.models import lora
+
+
+def _init_and_apply(name, B=2, L=16):
+    model = build(name, num_labels=3)
+    ids = jnp.ones((B, L), jnp.int32)
+    mask = jnp.ones((B, L), jnp.int32)
+    params = model.init(jax.random.key(0), ids, mask)
+    logits = model.apply(params, ids, mask)
+    return model, params, logits
+
+
+@pytest.mark.parametrize("name", ["tiny-bert", "tiny-albert"])
+def test_forward_shapes(name):
+    _, _, logits = _init_and_apply(name)
+    assert logits.shape == (2, 3)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_albert_shares_parameters():
+    b = build("tiny-bert").init(jax.random.key(0), jnp.ones((1, 8), jnp.int32),
+                                jnp.ones((1, 8), jnp.int32))
+    a = build("tiny-albert").init(jax.random.key(0), jnp.ones((1, 8), jnp.int32),
+                                  jnp.ones((1, 8), jnp.int32))
+    nb = sum(x.size for x in jax.tree.leaves(b))
+    na = sum(x.size for x in jax.tree.leaves(a))
+    assert na < nb  # shared layer + factorized embedding
+
+
+def test_padding_mask_invariance():
+    """Logits must not depend on token content in padded positions."""
+    model = build("tiny-bert")
+    ids = jnp.array([[2, 10, 11, 3, 0, 0, 0, 0]], jnp.int32)
+    mask = jnp.array([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+    params = model.init(jax.random.key(0), ids, mask)
+    l1 = model.apply(params, ids, mask)
+    ids2 = ids.at[0, 5].set(999)
+    l2 = model.apply(params, ids2, mask)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+
+def test_jit_forward_compiles_once():
+    model = build("tiny-bert")
+    ids = jnp.ones((4, 16), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids, mask)
+    f = jax.jit(lambda p, i, m: model.apply(p, i, m))
+    out1 = f(params, ids, mask)
+    out2 = f(params, ids + 1, mask)
+    assert out1.shape == out2.shape == (4, 2)
+
+
+def test_registry():
+    assert {"tiny-bert", "bert-base", "albert-base", "biobert-base"} <= set(list_models())
+    with pytest.raises(KeyError):
+        get_config("nope")
+
+
+def test_lora_identity_at_init_then_trains():
+    model = build("tiny-bert")
+    ids = jnp.ones((2, 8), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    variables = model.init(jax.random.key(0), ids, mask)
+    adapters = lora.init_lora(jax.random.key(1), variables["params"], rank=4)
+    assert len(adapters) > 0
+    merged = lora.apply_lora(variables["params"], adapters)
+    l0 = model.apply(variables, ids, mask)
+    l1 = model.apply({"params": merged}, ids, mask)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), atol=1e-6)
+    # perturb b -> output changes
+    for k in adapters:
+        adapters[k]["b"] = adapters[k]["b"] + 0.1
+    l2 = model.apply({"params": lora.apply_lora(variables["params"], adapters)}, ids, mask)
+    assert np.abs(np.asarray(l2) - np.asarray(l0)).max() > 1e-4
+    # adapters are much smaller than the base
+    assert lora.num_params(adapters) < 0.2 * lora.num_params(variables["params"])

@@ -1,0 +1,1 @@
+from bcfl_tpu.checkpoint.checkpoint import save_checkpoint, restore_latest  # noqa: F401

@@ -1,0 +1,58 @@
+"""Checkpoint/resume via Orbax.
+
+The reference only saves (``global_model.save_pretrained(...)`` every round,
+``serverless_NonIID_IMDB.py:305`` — doubling as its model-size probe) and has
+no load/resume path at all (SURVEY.md §5). Here a checkpoint is
+``(round, param state, ledger json, rng seed)`` and :func:`restore_latest`
+actually resumes a run mid-training.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
+                    ledger_json: Optional[str] = None) -> str:
+    """Write ``state`` (a pytree of arrays) for ``round_idx``; returns path."""
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"round_{round_idx:06d}")
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, _to_host(state), force=True)
+    if ledger_json is not None:
+        with open(os.path.join(directory, f"ledger_{round_idx:06d}.json"), "w") as f:
+            f.write(ledger_json)
+    return path
+
+
+def restore_latest(directory: str) -> Optional[Tuple[int, Dict[str, Any], Optional[str]]]:
+    """(round, state, ledger_json) of the newest checkpoint, or None."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    rounds = sorted(
+        int(d.split("_")[1]) for d in os.listdir(directory)
+        if d.startswith("round_") and d.split("_")[1].isdigit()
+    )
+    if not rounds:
+        return None
+    r = rounds[-1]
+    with ocp.PyTreeCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(directory, f"round_{r:06d}"))
+    ledger_path = os.path.join(directory, f"ledger_{r:06d}.json")
+    ledger_json = None
+    if os.path.exists(ledger_path):
+        with open(ledger_path) as f:
+            ledger_json = f.read()
+    return r, state, ledger_json

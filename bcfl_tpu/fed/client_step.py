@@ -86,10 +86,19 @@ class FedPrograms:
     mesh: ClientMesh
     server_round: Callable  # (global_t, frozen, batches, weights, rngs) -> (global_t, metrics)
     gossip_round: Callable  # (client_t, frozen, batches, mask, rngs) -> (client_t, metrics)
-    eval_clients: Callable  # (client_t_or_global, frozen, batches, stacked: bool) -> metrics
-    eval_global: Callable  # (trainable, frozen, batches) -> (loss, acc)
+    eval_clients: Callable  # (client_t, frozen, batches) -> per-client [C, 3] stats
+    eval_clients_global: Callable  # (global_t, frozen, batches) -> per-client [C, 3] stats
+    eval_global: Callable  # (trainable, frozen, batches) -> [loss*n, correct, n]
     broadcast: Callable  # global_t -> stacked client_t [C, ...]
-    collapse: Callable  # stacked client_t, weights -> global mean
+    collapse: Callable  # (stacked client_t, weights, fallback) -> global mean
+    # split-phase programs for the ledger flow (commit -> verify -> aggregate)
+    # and the async engine:
+    client_updates: Callable  # (global_t, frozen, batches, rngs) -> (stacked_t, metrics)
+    local_updates: Callable  # (client_t, frozen, batches, rngs) -> (stacked_t, metrics)
+    mix_only: Callable  # (client_t, mask, start_t) -> client_t (gossip mix / full mean)
+    single_update: Callable  # (trainable, frozen, batches, rng) -> (trainable, stats);
+    # un-shard_mapped single client, used by the reference-faithful sequential
+    # serverless mode (SURVEY.md §3.2)
 
 
 def build_programs(
@@ -157,25 +166,29 @@ def build_programs(
     )
 
     # ---- serverless mode: per-client params persist, ring gossip after ----
-    def gossip_shard(client_t, frozen, batches, mask, rngs):
-        def per_client(t, b, r):
-            return local_train(t, frozen, b, _unstack_rng(r))
-
-        new_t, stats = jax.vmap(per_client)(client_t, batches, rngs)
+    def _mix(new_t, mask, fallback):
+        """Post-train serverless aggregation. gossip_steps == 0 -> exact
+        mask-weighted all-client mean, the reference-faithful serverless
+        aggregation (serverless_NonIID_IMDB.py:296): every participating
+        client ends the round with the same average; ``fallback`` (the
+        round's STARTING per-client params) is what an all-masked round keeps.
+        gossip_steps > 0 -> masked ring diffusion."""
         if gossip_steps == 0:
-            # exact all-client mean, reference-faithful serverless aggregation
-            # (serverless_NonIID_IMDB.py:296): every client ends the round with
-            # the same (mask-weighted) average.
-            avg = masked_weighted_mean(new_t, mask, axis, fallback=client_t)
-            new_t = jax.tree.map(
+            avg = masked_weighted_mean(new_t, mask, axis, fallback=fallback)
+            return jax.tree.map(
                 lambda a, x: jnp.where(
                     mask.reshape((-1,) + (1,) * (x.ndim - 1)) > 0,
                     jnp.broadcast_to(a, x.shape), x),
                 avg, new_t,
             )
-        else:
-            new_t = gossip_mix(new_t, mask, gossip_alpha, axis, steps=gossip_steps)
-        return new_t, stats
+        return gossip_mix(new_t, mask, gossip_alpha, axis, steps=gossip_steps)
+
+    def gossip_shard(client_t, frozen, batches, mask, rngs):
+        def per_client(t, b, r):
+            return local_train(t, frozen, b, _unstack_rng(r))
+
+        new_t, stats = jax.vmap(per_client)(client_t, batches, rngs)
+        return _mix(new_t, mask, fallback=client_t), stats
 
     gossip_round = jax.jit(
         shard_map(
@@ -186,6 +199,49 @@ def build_programs(
         ),
         donate_argnums=(0,) if donate else (),
     )
+
+    # ---- split-phase programs (ledger commit/verify flow, async engine) ----
+    def client_updates_shard(global_t, frozen, batches, rngs):
+        new_t, stats = jax.vmap(
+            lambda b, r: local_train(global_t, frozen, b, _unstack_rng(r))
+        )(batches, rngs)
+        return new_t, stats
+
+    client_updates = jax.jit(
+        shard_map(
+            client_updates_shard, mesh=jmesh,
+            in_specs=(repl, repl, shard, shard),
+            out_specs=(shard, shard),
+            check_vma=False,
+        ),
+    )
+
+    def local_updates_shard(client_t, frozen, batches, rngs):
+        return jax.vmap(
+            lambda t, b, r: local_train(t, frozen, b, _unstack_rng(r))
+        )(client_t, batches, rngs)
+
+    local_updates = jax.jit(
+        shard_map(
+            local_updates_shard, mesh=jmesh,
+            in_specs=(shard, repl, shard, shard),
+            out_specs=(shard, shard),
+            check_vma=False,
+        ),
+    )
+
+    # split-phase serverless aggregation: ``fallback`` must be the round's
+    # STARTING stacked params (the engine keeps them across the
+    # local_updates -> ledger-verify -> mix_only sequence)
+    mix_only = jax.jit(
+        shard_map(
+            lambda client_t, mask, fallback: _mix(client_t, mask, fallback),
+            mesh=jmesh,
+            in_specs=(shard, shard, shard), out_specs=shard, check_vma=False,
+        ),
+    )
+
+    single_update = jax.jit(local_train)
 
     # ---- evaluation ----
     def eval_one(trainable, frozen, batches):
@@ -208,6 +264,18 @@ def build_programs(
         ),
     )
 
+    # Flower-style client evaluate: the ONE (global) model scored on each
+    # client's local test set (server_IID_IMDB.py:176-179)
+    eval_clients_global = jax.jit(
+        shard_map(
+            lambda g, f, b: jax.vmap(lambda bb: eval_one(g, f, bb))(b),
+            mesh=jmesh,
+            in_specs=(repl, repl, shard),
+            out_specs=shard,
+            check_vma=False,
+        ),
+    )
+
     eval_global = jax.jit(eval_one)
 
     # ---- layout helpers ----
@@ -219,10 +287,14 @@ def build_programs(
             mesh.client_sharding(),
         )
 
+    # ``fallback`` (replicated) is returned when every weight is zero — e.g. a
+    # round where all clients fail ledger authentication must NOT aggregate
+    # the rejected updates.
     collapse = jax.jit(
         shard_map(
-            lambda t, w: masked_weighted_mean(t, w, axis), mesh=jmesh,
-            in_specs=(shard, shard), out_specs=repl, check_vma=False,
+            lambda t, w, fallback: masked_weighted_mean(t, w, axis, fallback=fallback),
+            mesh=jmesh,
+            in_specs=(shard, shard, repl), out_specs=repl, check_vma=False,
         )
     )
 
@@ -231,7 +303,12 @@ def build_programs(
         server_round=server_round,
         gossip_round=gossip_round,
         eval_clients=eval_clients,
+        eval_clients_global=eval_clients_global,
         eval_global=eval_global,
         broadcast=broadcast,
         collapse=collapse,
+        client_updates=client_updates,
+        local_updates=local_updates,
+        mix_only=mix_only,
+        single_update=single_update,
     )

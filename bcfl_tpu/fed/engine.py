@@ -1,0 +1,433 @@
+"""Federated round engines — the orchestration layer.
+
+Replaces both reference orchestrators with one config-driven loop
+(SURVEY.md §1 L3a/L3b):
+
+- ``mode="server"``  — centralized FedAvg (reference: Flower
+  ``start_simulation`` + ``FedAvg`` strategy, ``server_IID_IMDB.py:205-218``),
+- ``mode="serverless"`` — P2P gossip (reference: hand-rolled round loop +
+  all-client mean, ``serverless_NonIID_IMDB.py:284-318``), with
+  ``faithful=True`` reproducing the reference's sequential shared-model quirk
+  exactly (clients mutate ONE model within a round — ``:288``, SURVEY.md §3.2),
+- ``sync="async"`` — buffered asynchronous aggregation (FedBuff-style) under a
+  simulated network clock derived from the latency graph; the reference only
+  *models* asynchrony as max-instead-of-sum info-passing time (MT nb cell 23).
+
+Per round the host control plane:
+1. runs the anomaly filter over the latency graph -> participation mask
+   (reference: offline notebook cells, never wired in — here it gates psum),
+2. (ledger mode) commits each client's update digest to the hash chain,
+   re-verifies digests, and zeroes the mask of any client whose shipped
+   update fails authentication (fault injection hook: ``tamper_hook``),
+3. launches the compiled round program on the mesh,
+4. records the reference metric set + info-passing times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bcfl_tpu.checkpoint import restore_latest, save_checkpoint
+from bcfl_tpu.config import FedConfig
+from bcfl_tpu.core import client_mesh, client_round_keys
+from bcfl_tpu.data import (
+    Partitioner,
+    TokenCache,
+    client_batches,
+    get_tokenizer,
+    load_dataset,
+)
+from bcfl_tpu.data.pipeline import central_eval_batches
+from bcfl_tpu.fed.client_step import FedPrograms, build_programs, _merge
+from bcfl_tpu.ledger import Ledger
+from bcfl_tpu.metrics import ResourceMonitor, RoundRecord, RunMetrics, model_size_gb
+from bcfl_tpu.models import TextClassifier, get_config, lora as lora_lib
+from bcfl_tpu.topology import anomaly_filter, random_graph, reference_graph
+from bcfl_tpu.topology.graph import LatencyGraph
+
+
+@dataclasses.dataclass
+class RunResult:
+    metrics: RunMetrics
+    trainable: object  # final global trainable (params or adapters)
+    params: object  # final merged full params
+    ledger: Optional[Ledger]
+
+
+class FedEngine:
+    def __init__(
+        self,
+        cfg: FedConfig,
+        tamper_hook: Optional[Callable] = None,
+        info_source: int = 1,
+    ):
+        self.cfg = cfg
+        self.tamper_hook = tamper_hook
+        self.root_key = jax.random.key(cfg.seed)
+
+        # --- data (tokenize once; SURVEY.md §3.2 fixes the 200x re-tokenize) ---
+        self.dataset = load_dataset(cfg.dataset, num_labels=cfg.num_labels)
+        self.tokenizer = get_tokenizer(cfg.tokenizer, cfg.vocab_size)
+        self.cache = TokenCache.build(self.dataset, self.tokenizer, cfg.seq_len)
+        self.num_labels = max(cfg.num_labels, self.cache.num_labels)
+        self.partitioner = Partitioner(
+            cfg.partition, self.dataset.n_train, self.dataset.n_test,
+            jax.random.fold_in(self.root_key, 1),
+        )
+
+        # --- model ---
+        if cfg.hf_checkpoint is not None:
+            from bcfl_tpu.models.hf_import import import_pretrained
+
+            model_cfg, variables = import_pretrained(
+                cfg.hf_checkpoint, num_labels=self.num_labels,
+                reinit_classifier=True,
+            )
+            self.model = TextClassifier(model_cfg)
+            params = variables["params"]
+        else:
+            model_cfg = get_config(
+                cfg.model, num_labels=self.num_labels,
+                vocab_size=self.tokenizer.vocab_size,
+            )
+            self.model = TextClassifier(model_cfg)
+            ids = jnp.ones((2, cfg.seq_len), jnp.int32)
+            params = self.model.init(
+                jax.random.fold_in(self.root_key, 2), ids, ids)["params"]
+
+        if cfg.lora_rank > 0:
+            self.frozen = params
+            self.trainable0 = lora_lib.init_lora(
+                jax.random.fold_in(self.root_key, 3), params, cfg.lora_rank)
+        else:
+            self.frozen = None
+            self.trainable0 = params
+
+        # --- mesh + programs ---
+        self.mesh = client_mesh(cfg.num_clients)
+        self.progs: FedPrograms = build_programs(
+            self.model, self.mesh,
+            optimizer=cfg.optimizer, learning_rate=cfg.learning_rate,
+            max_grad_norm=cfg.max_grad_norm,
+            gossip_alpha=cfg.topology.gossip_alpha,
+            gossip_steps=cfg.topology.gossip_steps,
+        )
+
+        # --- topology graph ---
+        if cfg.topology.bandwidth == "reference" and cfg.num_clients == 10:
+            self.graph: LatencyGraph = reference_graph()
+        else:
+            self.graph = random_graph(
+                cfg.num_clients, cfg.topology.bw_low, cfg.topology.bw_high,
+                seed=cfg.seed,
+            )
+        self.info_source = info_source % cfg.num_clients
+
+        self.ledger = Ledger(cfg.ledger.use_native) if cfg.ledger.enabled else None
+        self.eval_batches = jax.tree.map(
+            jnp.asarray, central_eval_batches(self.cache, cfg.batch_size))
+        self._static_batches = None  # cache when the partition is round-static
+
+    # ------------------------------------------------------------------ utils
+
+    def _round_batches(self, rnd: int):
+        cfg = self.cfg
+        static = not (cfg.partition.kind == "iid" and cfg.partition.resample_each_round)
+        if static and self._static_batches is not None:
+            return self._static_batches
+        tree, n_ex = client_batches(
+            self.cache, self.partitioner, cfg.num_clients, rnd, cfg.batch_size,
+            max_batches=cfg.max_local_batches,
+        )
+        out = (self.mesh.shard_clients(jax.tree.map(jnp.asarray, tree)),
+               np.asarray(n_ex))
+        if static:
+            self._static_batches = out
+        return out
+
+    def _test_batches(self, rnd: int):
+        cfg = self.cfg
+        tree, _ = client_batches(
+            self.cache, self.partitioner, cfg.num_clients, rnd, cfg.batch_size,
+            max_batches=cfg.max_local_batches, split="test",
+        )
+        return self.mesh.shard_clients(jax.tree.map(jnp.asarray, tree))
+
+    def _rngs(self, rnd: int):
+        keys = client_round_keys(
+            jax.random.fold_in(self.root_key, 4), self.cfg.num_clients, rnd)
+        return self.mesh.shard_clients(jax.random.key_data(keys))
+
+    def _participation(self, rnd: int) -> Dict:
+        return anomaly_filter(
+            self.cfg.topology.anomaly_filter, self.graph,
+            protect=(self.info_source,),
+        )
+
+    def _payload_gb(self) -> float:
+        return model_size_gb(self.trainable0)
+
+    def _global_eval(self, trainable) -> tuple:
+        s = np.asarray(self.progs.eval_global(trainable, self.frozen, self.eval_batches))
+        return float(s[0] / max(s[2], 1)), float(s[1] / max(s[2], 1))
+
+    def _ledger_verify(self, rnd: int, stacked) -> np.ndarray:
+        """Commit every client's update, then authenticate what 'arrived'
+        (tamper_hook simulates in-flight modification). Returns 0/1 auth mask."""
+        C = self.cfg.num_clients
+        host = jax.device_get(stacked)
+        for c in range(C):
+            self.ledger.append(rnd, c, jax.tree.map(lambda x: x[c], host))
+        shipped = self.tamper_hook(rnd, host) if self.tamper_hook else host
+        auth = np.ones((C,), np.float32)
+        for c in range(C):
+            ok = self.ledger.authenticate(rnd, c, jax.tree.map(lambda x: x[c], shipped))
+            auth[c] = 1.0 if ok else 0.0
+        return auth
+
+    # ------------------------------------------------------------------- run
+
+    def run(self, resume: bool = False) -> RunResult:
+        cfg = self.cfg
+        monitor = ResourceMonitor()
+        metrics = RunMetrics()
+        start_round = 0
+        trainable = self.trainable0
+        stacked = None
+
+        if resume and cfg.checkpoint_dir:
+            restored = restore_latest(cfg.checkpoint_dir)
+            if restored is not None:
+                start_round, state, ledger_json = restored
+                start_round += 1
+                if state.get("stacked") is not None:
+                    stacked = self.mesh.shard_clients(state["stacked"])
+                trainable = state["trainable"]
+                if ledger_json and self.ledger is not None:
+                    self.ledger = Ledger.from_json(
+                        ledger_json, cfg.ledger.use_native)
+
+        if cfg.mode == "serverless" and not cfg.faithful and stacked is None:
+            stacked = self.progs.broadcast(trainable)
+
+        async_state = self._init_async_state() if cfg.sync == "async" else None
+
+        for rnd in range(start_round, cfg.num_rounds):
+            t0 = time.time()
+            gate = self._participation(rnd)
+            mask = gate["mask"].astype(np.float32)
+
+            if cfg.sync == "async":
+                trainable, stacked, rec = self._async_round(
+                    rnd, trainable, stacked, mask, async_state)
+            elif cfg.mode == "server":
+                trainable, rec = self._server_round(rnd, trainable, mask)
+            elif cfg.faithful:
+                trainable, rec = self._faithful_round(rnd, trainable, mask)
+            else:
+                stacked, trainable, rec = self._serverless_round(
+                    rnd, stacked, trainable, mask)
+
+            rec.mask = mask.tolist()
+            rec.anomalies = list(gate["anomalies"])
+            sync_t, async_t = self.graph.info_passing_time(
+                self._payload_gb() if self.ledger is None
+                else self.cfg.ledger.entry_payload_bytes / 1e9,
+                source=self.info_source, anomalies=gate["anomalies"],
+            )
+            rec.info_passing_sync_s = sync_t
+            rec.info_passing_async_s = async_t
+            rec.wall_s = time.time() - t0
+
+            if cfg.eval_every and (rnd + 1) % cfg.eval_every == 0:
+                loss, acc = self._global_eval(trainable)
+                rec.global_loss, rec.global_acc = loss, acc
+                # reference-style per-client local accuracy on each client's
+                # LOCAL TEST split (serverless_NonIID_IMDB.py:291-292; Flower
+                # client.evaluate server_IID_IMDB.py:176-179)
+                tb = self._test_batches(rnd)
+                if stacked is not None:
+                    s = self.progs.eval_clients(stacked, self.frozen, tb)
+                else:
+                    s = self.progs.eval_clients_global(trainable, self.frozen, tb)
+                s = np.asarray(s)
+                rec.local_acc = (s[:, 1] / np.maximum(s[:, 2], 1)).tolist()
+            metrics.rounds.append(rec)
+
+            if cfg.checkpoint_dir and cfg.checkpoint_every and \
+                    (rnd + 1) % cfg.checkpoint_every == 0:
+                state = {
+                    "trainable": jax.device_get(trainable),
+                    "stacked": jax.device_get(stacked) if stacked is not None else None,
+                }
+                save_checkpoint(
+                    cfg.checkpoint_dir, rnd, state,
+                    self.ledger.to_json() if self.ledger else None,
+                )
+
+        params = _merge(trainable, self.frozen)
+        metrics.model_size_gb = model_size_gb(params)
+        metrics.resources = monitor.snapshot()
+        if self.ledger is not None and len(self.ledger):
+            metrics.ledger = self.ledger.payload_accounting()
+            metrics.ledger["chain_ok"] = float(self.ledger.verify_chain() == -1)
+        return RunResult(metrics=metrics, trainable=trainable, params=params,
+                         ledger=self.ledger)
+
+    # ----------------------------------------------------------- round bodies
+
+    def _stats_to_rec(self, rnd: int, stats) -> RoundRecord:
+        s = np.asarray(stats)  # [C, 3]
+        n = np.maximum(s[:, 2], 1)
+        total = s.sum(0)
+        return RoundRecord(
+            round=rnd,
+            train_loss=float(total[0] / max(total[2], 1)),
+            train_acc=float(total[1] / max(total[2], 1)),
+            local_acc=(s[:, 1] / n).tolist(),
+        )
+
+    def _weights(self, mask: np.ndarray, n_ex: np.ndarray) -> jnp.ndarray:
+        w = mask * (n_ex if self.cfg.weighted_agg else 1.0)
+        return self.mesh.shard_clients(jnp.asarray(w, jnp.float32))
+
+    def _server_round(self, rnd, trainable, mask):
+        batches, n_ex = self._round_batches(rnd)
+        rngs = self._rngs(rnd)
+        if self.ledger is None:
+            w = self._weights(mask, n_ex)
+            trainable, stats = self.progs.server_round(
+                trainable, self.frozen, batches, w, rngs)
+            return trainable, self._stats_to_rec(rnd, stats)
+        # ledger flow: commit -> verify -> aggregate; if every update fails
+        # authentication the round keeps its starting params (fallback)
+        stacked, stats = self.progs.client_updates(
+            trainable, self.frozen, batches, rngs)
+        auth = self._ledger_verify(rnd, stacked)
+        w = self._weights(mask * auth, n_ex)
+        trainable = self.progs.collapse(stacked, w, trainable)
+        return trainable, self._stats_to_rec(rnd, stats)
+
+    def _serverless_round(self, rnd, stacked, prev_consensus, mask):
+        batches, n_ex = self._round_batches(rnd)
+        rngs = self._rngs(rnd)
+        m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
+        if self.ledger is None:
+            stacked, stats = self.progs.gossip_round(
+                stacked, self.frozen, batches, m, rngs)
+        else:
+            start = stacked  # pre-train params: what an all-rejected round keeps
+            stacked, stats = self.progs.local_updates(
+                stacked, self.frozen, batches, rngs)
+            auth = self._ledger_verify(rnd, stacked)
+            m = self.mesh.shard_clients(jnp.asarray(mask * auth, jnp.float32))
+            stacked = self.progs.mix_only(stacked, m, start)
+        # consensus view for eval/checkpoint (mask-weighted mean)
+        consensus = self.progs.collapse(stacked, m, prev_consensus)
+        return stacked, consensus, self._stats_to_rec(rnd, stats)
+
+    def _faithful_round(self, rnd, trainable, mask):
+        """Reference-exact serverless semantics: clients sequentially mutate a
+        shared model within the round, snapshots are averaged unweighted
+        (``serverless_NonIID_IMDB.py:284-297``). Host-sequential by nature."""
+        cfg = self.cfg
+        batches, n_ex = self._round_batches(rnd)
+        host_b = jax.device_get(batches)
+        keys = client_round_keys(
+            jax.random.fold_in(self.root_key, 4), cfg.num_clients, rnd)
+        snapshots, all_stats = [], []
+        shared = trainable
+        for c in range(cfg.num_clients):
+            cb = jax.tree.map(lambda x: jnp.asarray(x[c]), host_b)
+            shared, stats = self.progs.single_update(shared, self.frozen, cb, keys[c])
+            if self.ledger is not None:
+                self.ledger.append(rnd, c, jax.device_get(shared))
+            snapshots.append(shared)
+            all_stats.append(np.asarray(stats))
+        ws = mask / max(mask.sum(), 1.0)
+        avg = jax.tree.map(
+            lambda *xs: sum(w * x for w, x in zip(ws, xs)), *snapshots)
+        return avg, self._stats_to_rec(rnd, np.stack(all_stats))
+
+    # ------------------------------------------------------------------ async
+
+    def _init_async_state(self) -> Dict:
+        """Simulated network clock: per-client round duration = local compute
+        (proportional to examples) + transfer time to the aggregation point
+        over the latency graph (the quantity the notebooks call information
+        passing time)."""
+        cfg = self.cfg
+        times = self.graph.shortest_path_times(self._payload_gb())
+        src = self.info_source
+        transfer = np.array([
+            times[c, src] if c != src else 0.0 for c in range(cfg.num_clients)])
+        compute = np.ones((cfg.num_clients,))  # uniform local-compute cost
+        duration = compute + transfer
+        return {
+            "duration": duration,
+            "next_done": duration.copy(),
+            "version": np.zeros((cfg.num_clients,), np.int64),
+            "global_version": 0,
+            "clock": 0.0,
+        }
+
+    def _async_round(self, rnd, trainable, stacked, mask, st):
+        """One buffered-async aggregation event (FedBuff-style): the K
+        earliest-finishing clients merge, staleness-decayed; others keep
+        training on their stale base."""
+        cfg = self.cfg
+        K = cfg.async_buffer or cfg.num_clients
+        if stacked is None:
+            stacked = self.progs.broadcast(trainable)
+        batches, n_ex = self._round_batches(rnd)
+        rngs = self._rngs(rnd)
+        stacked, stats = self.progs.local_updates(
+            stacked, self.frozen, batches, rngs)
+
+        if self.ledger is not None:
+            auth = self._ledger_verify(rnd, stacked)
+            mask = mask * auth
+
+        # pick the K earliest arrivals among participating clients
+        order = np.argsort(st["next_done"])
+        arrived = [c for c in order if mask[c] > 0][:K]
+        st["clock"] = float(st["next_done"][arrived].max()) if arrived else st["clock"]
+
+        staleness = st["global_version"] - st["version"]
+        alpha = np.zeros((cfg.num_clients,), np.float32)
+        for c in arrived:
+            alpha[c] = cfg.staleness_decay ** max(int(staleness[c]), 0)
+        if self.cfg.weighted_agg:
+            alpha = alpha * n_ex
+
+        if arrived:
+            merged = self.progs.collapse(
+                stacked, self.mesh.shard_clients(jnp.asarray(alpha)), trainable)
+            # server-style incremental merge: global <- (1-a) global + a merged
+            a = float(np.clip(alpha[arrived].sum() /
+                              (alpha[arrived].sum() + len(arrived)), 0.1, 0.9))
+            trainable = jax.tree.map(
+                lambda g, m: (1 - a) * g + a * m, trainable, merged)
+            # arrived clients pull the fresh global and restart
+            pull = np.zeros((cfg.num_clients,), np.float32)
+            pull[arrived] = 1.0
+            pull_d = self.mesh.shard_clients(jnp.asarray(pull))
+            bcast = self.progs.broadcast(trainable)
+            stacked = jax.jit(
+                lambda s, b, p: jax.tree.map(
+                    lambda x, y: jnp.where(
+                        p.reshape((-1,) + (1,) * (x.ndim - 1)) > 0, y, x), s, b)
+            )(stacked, bcast, pull_d)
+            st["global_version"] += 1
+            for c in arrived:
+                st["version"][c] = st["global_version"]
+                st["next_done"][c] = st["clock"] + st["duration"][c]
+
+        rec = self._stats_to_rec(rnd, stats)
+        return trainable, stacked, rec

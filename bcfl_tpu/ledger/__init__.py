@@ -1,0 +1,1 @@
+from bcfl_tpu.ledger.ledger import Ledger, LedgerEntry, params_digest  # noqa: F401

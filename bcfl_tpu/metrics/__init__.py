@@ -1,0 +1,6 @@
+from bcfl_tpu.metrics.metrics import (  # noqa: F401
+    ResourceMonitor,
+    RoundRecord,
+    RunMetrics,
+    model_size_gb,
+)

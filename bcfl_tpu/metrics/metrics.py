@@ -1,0 +1,98 @@
+"""Run metrics — the reference's observability surface (SURVEY.md §3.5), with
+its bugs fixed but its metric set preserved for comparability:
+
+- wall-clock latency in minutes (``server_IID_IMDB.py:221-224`` prints
+  "Latency : X mins"),
+- CPU overhead percent via psutil (``:59-63, 226-229``),
+- memory overhead in GB — the reference captures ``memory_info_after``
+  BEFORE training and ``memory_info_before`` after, so it usually prints a
+  negative number (C11); here before is before and after is after,
+- model size in GB (reference: ``save_pretrained`` + ``os.path.getsize``,
+  ``serverless_IID_IMDB.py:280-284``; here computed from the param tree
+  directly — no disk round-trip needed),
+- per-client local accuracy per round and global accuracy per round
+  (``serverless_NonIID_IMDB.py:292, 304, 334``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def model_size_gb(tree) -> float:
+    return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree)) / 1e9
+
+
+class ResourceMonitor:
+    """before/after psutil capture, with before actually before."""
+
+    def __init__(self):
+        import psutil
+
+        self._proc = psutil.Process()
+        self._psutil = psutil
+        self.cpu_before = self._proc.cpu_percent()
+        self.rss_before = self._proc.memory_info().rss
+        self.t_before = time.time()
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "cpu_percent": self._proc.cpu_percent(),
+            "memory_gb": (self._proc.memory_info().rss - self.rss_before) / 1e9,
+            "latency_min": (time.time() - self.t_before) / 60.0,
+        }
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    round: int
+    train_loss: float
+    train_acc: float
+    local_acc: List[float]  # per client
+    global_acc: Optional[float] = None
+    global_loss: Optional[float] = None
+    mask: Optional[List[float]] = None
+    anomalies: Optional[List[int]] = None
+    info_passing_sync_s: Optional[float] = None
+    info_passing_async_s: Optional[float] = None
+    wall_s: float = 0.0
+
+
+@dataclasses.dataclass
+class RunMetrics:
+    rounds: List[RoundRecord] = dataclasses.field(default_factory=list)
+    model_size_gb: float = 0.0
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    ledger: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def global_accuracies(self) -> List[float]:
+        """The reference's ``global_accuracies`` list
+        (``serverless_NonIID_IMDB.py:334``)."""
+        return [r.global_acc for r in self.rounds if r.global_acc is not None]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "rounds": [dataclasses.asdict(r) for r in self.rounds],
+            "model_size_gb": self.model_size_gb,
+            "resources": self.resources,
+            "ledger": self.ledger,
+            "global_accuracies": self.global_accuracies,
+        }, indent=2)
+
+    def summary(self) -> str:
+        accs = self.global_accuracies
+        lines = [
+            f"rounds: {len(self.rounds)}",
+            f"model size: {self.model_size_gb:.4f} GB",
+            f"final global accuracy: {accs[-1]:.4f}" if accs else "no global eval",
+        ]
+        for k, v in self.resources.items():
+            lines.append(f"{k}: {v:.3f}")
+        return "\n".join(lines)

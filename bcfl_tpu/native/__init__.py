@@ -1,0 +1,7 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+Build is on-demand and cached next to the sources; everything here has a pure
+Python fallback so the framework never hard-requires a toolchain.
+"""
+
+from bcfl_tpu.native.build import load_ledger_lib  # noqa: F401

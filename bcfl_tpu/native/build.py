@@ -1,0 +1,60 @@
+"""On-demand g++ build + ctypes binding for the native ledger core."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "sha256.cc")
+_LIB = os.path.join(_DIR, "libbcfl_ledger.so")
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _compile() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load_ledger_lib() -> Optional[ctypes.CDLL]:
+    """The compiled ledger library, building it on first use; None if no
+    toolchain is available (callers fall back to hashlib)."""
+    global _cached, _failed
+    with _lock:
+        if _cached is not None:
+            return _cached
+        if _failed:
+            return None
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _compile():
+                _failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _failed = True
+            return None
+        lib.bcfl_sha256.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+        lib.bcfl_sha256_multi.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64, ctypes.c_char_p]
+        lib.bcfl_chain_extend.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+        lib.bcfl_chain_verify.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.bcfl_chain_verify.restype = ctypes.c_int64
+        _cached = lib
+        return lib

@@ -1,0 +1,7 @@
+from bcfl_tpu.topology.graph import (  # noqa: F401
+    LatencyGraph,
+    REFERENCE_BANDWIDTH_MBPS,
+    reference_graph,
+    random_graph,
+)
+from bcfl_tpu.topology.filters import anomaly_filter, FILTERS  # noqa: F401

@@ -117,7 +117,7 @@ def test_collapse_equals_mean():
     ds, cache, part, model, mesh, progs, params = _setup()
     cp = progs.broadcast(params)
     w = mesh.shard_clients(jnp.ones((8,)))
-    g = progs.collapse(cp, w)
+    g = progs.collapse(cp, w, params)
     for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 

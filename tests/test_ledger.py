@@ -1,0 +1,97 @@
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcfl_tpu.ledger import Ledger, params_digest
+from bcfl_tpu.native.build import load_ledger_lib
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "layer": {"kernel": rng.normal(size=(8, 8)).astype(np.float32),
+                  "bias": np.zeros((8,), np.float32)},
+        "head": {"kernel": rng.normal(size=(8, 2)).astype(np.float32)},
+    }
+
+
+def test_native_library_builds_and_matches_hashlib():
+    lib = load_ledger_lib()
+    if lib is None:
+        pytest.skip("no g++ toolchain")
+    import ctypes
+
+    for payload in [b"", b"abc", b"x" * 1000, bytes(range(256)) * 33]:
+        out = ctypes.create_string_buffer(32)
+        lib.bcfl_sha256(payload, len(payload), out)
+        assert out.raw == hashlib.sha256(payload).digest()
+
+
+def test_params_digest_native_equals_python():
+    t = _tree()
+    assert params_digest(t, use_native=True) == params_digest(t, use_native=False)
+
+
+def test_digest_sensitive_to_values_names_and_shapes():
+    base = params_digest(_tree(0))
+    assert params_digest(_tree(1)) != base
+    t = _tree(0)
+    t["layer"]["bias"][0] = 1e-7  # one float flips the digest
+    assert params_digest(t) != base
+    t2 = {"renamed": _tree(0)["layer"], "head": _tree(0)["head"]}
+    assert params_digest(t2) != base
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_chain_append_verify_tamper(use_native):
+    led = Ledger(use_native=use_native)
+    for rnd in range(3):
+        for c in range(4):
+            led.append(rnd, c, _tree(rnd * 4 + c))
+    assert len(led) == 12
+    assert led.verify_chain() == -1
+
+    # tamper with entry 5's digest -> chain breaks exactly there
+    import dataclasses
+
+    bad = dataclasses.replace(led.entries[5], params_digest=b"\xff" * 32)
+    led.entries[5] = bad
+    assert led.verify_chain() == 5
+
+
+def test_authenticate_accepts_committed_rejects_tampered():
+    led = Ledger()
+    t = _tree(7)
+    led.append(0, 2, t)
+    assert led.authenticate(0, 2, t)
+    t["head"]["kernel"][0, 0] += 1.0  # poisoned after commit
+    assert not led.authenticate(0, 2, t)
+    assert not led.authenticate(0, 3, t)  # never committed
+
+
+def test_payload_accounting_reduction():
+    led = Ledger()
+    big = {"w": np.zeros((512, 512), np.float32)}  # 1 MB update
+    for c in range(8):
+        led.append(0, c, big)
+    acc = led.payload_accounting()
+    assert acc["full_weights_gb"] == pytest.approx(8 * 512 * 512 * 4 / 1e9)
+    assert acc["ledger_gb"] < 1e-5
+    assert acc["reduction"] > 0.999  # entries are ~100 B vs 1 MB updates
+
+
+def test_json_roundtrip_preserves_chain():
+    led = Ledger()
+    for c in range(3):
+        led.append(0, c, _tree(c))
+    led2 = Ledger.from_json(led.to_json())
+    assert led2.verify_chain() == -1
+    assert led2.head == led.head
+
+
+def test_jax_arrays_digest_like_numpy():
+    t_np = _tree(3)
+    t_jax = {k: {k2: jnp.asarray(v2) for k2, v2 in v.items()} for k, v in t_np.items()}
+    assert params_digest(t_np) == params_digest(t_jax)

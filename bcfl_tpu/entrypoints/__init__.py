@@ -1,0 +1,9 @@
+"""Entrypoints: presets (the 11 reference scripts) + one ``run(config)``."""
+
+from bcfl_tpu.entrypoints.presets import (  # noqa: F401
+    SWEEP_CLIENTS,
+    build_presets,
+    get_preset,
+    list_presets,
+)
+from bcfl_tpu.entrypoints.run import format_report, run, run_sweep  # noqa: F401

@@ -1,0 +1,88 @@
+"""CLI: ``python -m bcfl_tpu.entrypoints --preset serverless_noniid_imdb``.
+
+Replaces running the 11 reference scripts directly; every SURVEY.md §2.1
+config knob is an override flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from bcfl_tpu.entrypoints.presets import _HF, get_preset, list_presets
+from bcfl_tpu.entrypoints.run import run, run_sweep
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="bcfl_tpu")
+    ap.add_argument("--preset", default="smoke",
+                    help=f"one of: {', '.join(list_presets())}")
+    ap.add_argument("--hf", action="store_true",
+                    help="import real HF checkpoint weights (needs hub access)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the 5/10/20-worker sweep like "
+                         "serverless_cancer_biobert_allclients.py")
+    ap.add_argument("--resume", action="store_true")
+    # common overrides (None = keep preset value)
+    ap.add_argument("--clients", type=int, default=None)
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--dataset", default=None)
+    ap.add_argument("--mode", choices=["server", "serverless"], default=None)
+    ap.add_argument("--sync", choices=["sync", "async"], default=None)
+    ap.add_argument("--seq-len", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--lr", type=float, default=None)
+    ap.add_argument("--lora-rank", type=int, default=None)
+    ap.add_argument("--max-local-batches", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--faithful", action="store_true",
+                    help="reference-exact sequential serverless semantics")
+    ap.add_argument("--anomaly-filter",
+                    choices=["pagerank", "dbscan", "zscore", "community", "none"],
+                    default=None)
+    ap.add_argument("--ledger", action="store_true",
+                    help="enable the hash-chained weight ledger (BC-FL)")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    cfg = get_preset(args.preset, hf=args.hf)
+    simple = {
+        "clients": "num_clients", "rounds": "num_rounds", "model": "model",
+        "dataset": "dataset", "mode": "mode", "sync": "sync",
+        "seq_len": "seq_len", "batch_size": "batch_size",
+        "lr": "learning_rate", "lora_rank": "lora_rank",
+        "max_local_batches": "max_local_batches", "seed": "seed",
+        "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
+    }
+    overrides = {}
+    for arg_name, cfg_name in simple.items():
+        v = getattr(args, arg_name)
+        if v is not None:
+            overrides[cfg_name] = v
+    if args.model is not None and cfg.hf_checkpoint is not None:
+        # keep checkpoint/tokenizer consistent with the overridden architecture
+        if args.model not in _HF:
+            raise SystemExit(
+                f"--model {args.model!r} has no HF checkpoint mapping; "
+                f"under --hf use one of {sorted(_HF)}")
+        overrides["hf_checkpoint"] = _HF[args.model]
+        overrides["tokenizer"] = _HF[args.model]
+    if args.faithful:
+        overrides["faithful"] = True
+    if args.anomaly_filter is not None:
+        f = None if args.anomaly_filter == "none" else args.anomaly_filter
+        overrides["topology"] = dataclasses.replace(cfg.topology, anomaly_filter=f)
+    if args.ledger:
+        overrides["ledger"] = dataclasses.replace(cfg.ledger, enabled=True)
+    cfg = cfg.replace(**overrides)
+
+    if args.sweep:
+        run_sweep(cfg, resume=args.resume)
+    else:
+        run(cfg, resume=args.resume)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""The reference's 11 scripts as config presets.
+
+The reference's configuration space is 11 near-copy files whose deltas are
+module-level constants (the matrix in SURVEY.md §2.1). Each row becomes a
+named :class:`~bcfl_tpu.config.FedConfig` preset over ONE engine; run any of
+them with ``python -m bcfl_tpu.entrypoints --preset <name>``.
+
+Reference citations per preset are in the individual docstring comments.
+Notes on reference quirks preserved / fixed:
+
+- ``server_noniid_imdb``: the reference defines ``load_data_count(count)`` but
+  calls it once with ``count=0`` and never increments (``server_NonIID_IMDB.py
+  :224-225``) so all Ray clients share one loader. We implement the *intended*
+  per-client contiguous slices (the 300k/240 schedule).
+- ``serverless_cancer_biobert_allclients``: the reference builds ``net`` with
+  3 labels but ``global_model`` with 41 (``serverless_cancer_biobert_allclients
+  .py:117`` vs ``:242``) — a latent shape bug. We hard-error on such mismatch
+  by construction (one ``num_labels`` knob).
+- HF checkpoints (``albert-base-v2``, ``dmis-lab/biobert-v1.1``) need hub
+  access; presets default to the same-architecture registry config with fresh
+  init, and ``hf=True`` switches on real weight import.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from bcfl_tpu.config import FedConfig, LedgerConfig, PartitionConfig, TopologyConfig
+
+_HF = {
+    "albert-base": "albert-base-v2",
+    "biobert-base": "dmis-lab/biobert-v1.1",
+    "bert-base": "bert-base-uncased",
+}
+
+
+def _mk(name: str, model: str, hf: bool, **kw) -> FedConfig:
+    extra = dict(kw)
+    if hf:
+        extra["hf_checkpoint"] = _HF[model]
+        extra["tokenizer"] = _HF[model]
+    return FedConfig(name=name, model=model, **extra)
+
+
+def build_presets(hf: bool = False) -> Dict[str, FedConfig]:
+    """All presets; ``hf=True`` imports real HF weights/tokenizers (needs hub
+    access — in air-gapped runs keep False for same-architecture fresh init)."""
+    p: Dict[str, FedConfig] = {}
+
+    # ---- Servercase (Flower-simulation scripts -> mode="server") ----
+    # server_IID_IMDB.py: biobert (:48), 2 labels, 20 clients/20 rounds
+    # (:49-50), IID 100 shared random indices (:79-84)
+    p["server_iid_imdb"] = _mk(
+        "server_iid_imdb", "biobert-base", hf,
+        dataset="imdb", num_labels=2, mode="server",
+        num_clients=20, num_rounds=20,
+        partition=PartitionConfig(kind="iid", iid_samples=100),
+    )
+    # server_NonIID_IMDB.py: albert (:48), intended 300k/240 contiguous
+    # schedule (:83-84)
+    p["server_noniid_imdb"] = _mk(
+        "server_noniid_imdb", "albert-base", hf,
+        dataset="imdb", num_labels=2, mode="server",
+        num_clients=20, num_rounds=20,
+        partition=PartitionConfig(
+            kind="contiguous", stride=300, train_span=240, test_span=60,
+            test_mode="trailing"),
+    )
+    # server_iid_medical_transcirptions.py: biobert, 40 labels (:124),
+    # 5 clients (:30), IID 500 (:59-60)
+    p["server_iid_medical"] = _mk(
+        "server_iid_medical", "biobert-base", hf,
+        dataset="medical_transcriptions", num_labels=40, mode="server",
+        num_clients=5, num_rounds=20,
+        partition=PartitionConfig(kind="iid", iid_samples=500),
+    )
+    # server_noniid_medical_transcriptions.py: biobert, 10 clients (:30),
+    # 500i/400 slices w/ fixed test [0,400) (:55-56)
+    p["server_noniid_medical"] = _mk(
+        "server_noniid_medical", "biobert-base", hf,
+        dataset="medical_transcriptions", num_labels=40, mode="server",
+        num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="contiguous", stride=500, train_span=400, test_span=400,
+            test_mode="fixed"),
+    )
+
+    # ---- Serverlesscase (manual round loops -> mode="serverless") ----
+    # serverless_IID_IMDB.py: albert (:31), 10 clients (:32), fresh
+    # 100-random resample per client per round (:258)
+    p["serverless_iid_imdb"] = _mk(
+        "serverless_iid_imdb", "albert-base", hf,
+        dataset="imdb", num_labels=2, mode="serverless", weighted_agg=False,
+        num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="iid", iid_samples=100, resample_each_round=True),
+    )
+    # serverless_NonIID_IMDB.py: albert (:30), 300k/240 trailing slices
+    # (:59-60), unweighted mean (:296)
+    p["serverless_noniid_imdb"] = _mk(
+        "serverless_noniid_imdb", "albert-base", hf,
+        dataset="imdb", num_labels=2, mode="serverless", weighted_agg=False,
+        num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="contiguous", stride=300, train_span=240, test_span=60,
+            test_mode="trailing"),
+    )
+    # Serverless_iid_Medical_transcriptions.py: biobert (:28), 20 clients
+    # (:30), IID 500 per round (:54-55, :238)
+    p["serverless_iid_medical"] = _mk(
+        "serverless_iid_medical", "biobert-base", hf,
+        dataset="medical_transcriptions", num_labels=40, mode="serverless",
+        weighted_agg=False, num_clients=20, num_rounds=20,
+        partition=PartitionConfig(
+            kind="iid", iid_samples=500, resample_each_round=True),
+    )
+    # Serverless_NonIID_Medical_transcriptions.py: biobert, 10 clients (:30),
+    # 500i/400 slices, fixed test (:55-56)
+    p["serverless_noniid_medical"] = _mk(
+        "serverless_noniid_medical", "biobert-base", hf,
+        dataset="medical_transcriptions", num_labels=40, mode="serverless",
+        weighted_agg=False, num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="contiguous", stride=500, train_span=400, test_span=400,
+            test_mode="fixed"),
+    )
+    # serverless_covid_iid.py: albert (:32), 41 labels (:122), 10 clients,
+    # IID 500 per round (:253)
+    p["serverless_covid_iid"] = _mk(
+        "serverless_covid_iid", "albert-base", hf,
+        dataset="covid", num_labels=41, mode="serverless", weighted_agg=False,
+        num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="iid", iid_samples=500, resample_each_round=True),
+    )
+    # serverless_caner_classification_iid.py: albert (:32), 41 labels (:120),
+    # IID 500 per round (:251)
+    p["serverless_cancer_iid"] = _mk(
+        "serverless_cancer_iid", "albert-base", hf,
+        dataset="cancer", num_labels=41, mode="serverless", weighted_agg=False,
+        num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="iid", iid_samples=500, resample_each_round=True),
+    )
+    # serverless_cancer_biobert_allclients.py: biobert (:39), sweep handled by
+    # run_sweep(); single-config preset uses 10 clients. num_labels unified to
+    # 41 (see module docstring on the reference's 3-vs-41 bug).
+    p["serverless_cancer_biobert"] = _mk(
+        "serverless_cancer_biobert", "biobert-base", hf,
+        dataset="cancer", num_labels=41, mode="serverless", weighted_agg=False,
+        num_clients=10, num_rounds=20,
+        partition=PartitionConfig(
+            kind="iid", iid_samples=500, resample_each_round=True),
+    )
+
+    # ---- extended capabilities the reference only describes ----
+    # BC-FL: hash-chained ledger + PageRank gating + async gossip
+    # (README.md:10; MT notebook cells 23-28)
+    p["bcfl_async_pagerank"] = _mk(
+        "bcfl_async_pagerank", "albert-base", hf,
+        dataset="imdb", num_labels=2, mode="serverless", sync="async",
+        weighted_agg=False, num_clients=10, num_rounds=20, async_buffer=4,
+        partition=PartitionConfig(kind="iid", iid_samples=100,
+                                  resample_each_round=True),
+        topology=TopologyConfig(anomaly_filter="pagerank"),
+        ledger=LedgerConfig(enabled=True),
+    )
+    # smoke: the reference's de-facto test = NUM_CLIENTS=2/NUM_ROUNDS=2
+    # scale-down (serverless_cancer_classification_with_BioBERT.ipynb)
+    p["smoke"] = FedConfig(
+        name="smoke", model="tiny-bert", dataset="synthetic", num_labels=2,
+        mode="serverless", weighted_agg=False, num_clients=2, num_rounds=2,
+        seq_len=64, max_local_batches=2,
+        partition=PartitionConfig(kind="iid", iid_samples=64),
+    )
+    return p
+
+
+def get_preset(name: str, hf: bool = False) -> FedConfig:
+    presets = build_presets(hf)
+    if name not in presets:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(presets)}")
+    return presets[name]
+
+
+def list_presets() -> List[str]:
+    return sorted(build_presets())
+
+
+# the reference's worker sweep: ``for NUM_CLIENTS in [5, 10, 20]``
+# (serverless_cancer_biobert_allclients.py:41)
+SWEEP_CLIENTS = [5, 10, 20]

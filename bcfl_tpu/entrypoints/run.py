@@ -1,0 +1,77 @@
+"""``run(config)`` — the single entrypoint replacing all 11 reference scripts.
+
+Prints the reference's metric set at the end (CPU overhead %, memory GB,
+latency minutes, model size GB, per-round local/global accuracy — the prints
+at ``serverless_NonIID_IMDB.py:320-334`` and ``server_IID_IMDB.py:221-233``),
+plus the info-passing-time and ledger accounting the notebooks model offline.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from bcfl_tpu.config import FedConfig
+from bcfl_tpu.fed.engine import FedEngine, RunResult
+
+
+def run(cfg: FedConfig, resume: bool = False, verbose: bool = True) -> RunResult:
+    engine = FedEngine(cfg)
+    result = engine.run(resume=resume)
+    if verbose:
+        print(format_report(cfg, result))
+    return result
+
+
+def format_report(cfg: FedConfig, result: RunResult) -> str:
+    m = result.metrics
+    lines = [
+        f"== {cfg.name} ==",
+        f"mode={cfg.mode} sync={cfg.sync} clients={cfg.num_clients} "
+        f"rounds={cfg.num_rounds} model={cfg.model} dataset={cfg.dataset}",
+    ]
+    for r in m.rounds:
+        acc = f" global_acc={r.global_acc:.4f}" if r.global_acc is not None else ""
+        anom = f" anomalies={r.anomalies}" if r.anomalies else ""
+        lines.append(
+            f"round {r.round:3d}: train_loss={r.train_loss:.4f} "
+            f"train_acc={r.train_acc:.4f}{acc}{anom} wall={r.wall_s:.2f}s"
+        )
+    # reference metric names (server_IID_IMDB.py:221-233, with the reversed
+    # before/after memory naming fixed — SURVEY.md C11)
+    lines.append(m.summary())
+    if m.rounds and m.rounds[-1].info_passing_sync_s is not None:
+        r = m.rounds[-1]
+        lines.append(
+            f"info passing time: sync={r.info_passing_sync_s:.3f}s "
+            f"async={r.info_passing_async_s:.3f}s"
+        )
+    if m.ledger:
+        lines.append("ledger: " + json.dumps(m.ledger))
+    accs = m.global_accuracies
+    lines.append(f"global_accuracies: {[round(a, 4) for a in accs]}")
+    return "\n".join(lines)
+
+
+def run_sweep(
+    cfg: FedConfig,
+    client_counts: Optional[List[int]] = None,
+    resume: bool = False,
+    verbose: bool = True,
+) -> Dict[int, RunResult]:
+    """The reference's worker sweep (``for NUM_CLIENTS in [5,10,20]``,
+    ``serverless_cancer_biobert_allclients.py:41``) over one config. Each
+    client count checkpoints into its own subdirectory."""
+    import os
+
+    from bcfl_tpu.entrypoints.presets import SWEEP_CLIENTS
+
+    out: Dict[int, RunResult] = {}
+    for n in client_counts or SWEEP_CLIENTS:
+        ckpt = (os.path.join(cfg.checkpoint_dir, f"c{n}")
+                if cfg.checkpoint_dir else None)
+        out[n] = run(
+            cfg.replace(name=f"{cfg.name}_c{n}", num_clients=n,
+                        checkpoint_dir=ckpt),
+            resume=resume, verbose=verbose)
+    return out

@@ -1,0 +1,38 @@
+"""Synthetic round inputs for benches/dry-runs: the stacked per-client batch
+tree :func:`bcfl_tpu.data.pipeline.client_batches` produces, filled with
+random tokens, plus uniform weights and per-client RNGs, all device-put onto
+the client mesh."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bcfl_tpu.core.mesh import ClientMesh
+
+
+def synthetic_round_inputs(
+    mesh: ClientMesh,
+    steps: int,
+    batch: int,
+    seq: int,
+    vocab_size: int = 8192,
+    num_labels: int = 2,
+    seed: int = 0,
+):
+    """Returns ``(batches, weights, rngs)`` ready for any FedPrograms round."""
+    C = mesh.num_clients
+    rng = np.random.default_rng(seed)
+    batches = mesh.shard_clients({
+        "ids": jnp.asarray(
+            rng.integers(0, vocab_size, (C, steps, batch, seq)), jnp.int32),
+        "mask": jnp.ones((C, steps, batch, seq), jnp.int32),
+        "labels": jnp.asarray(
+            rng.integers(0, num_labels, (C, steps, batch)), jnp.int32),
+        "example_mask": jnp.ones((C, steps, batch), jnp.float32),
+    })
+    weights = mesh.shard_clients(jnp.ones((C,), jnp.float32))
+    keys = jax.random.split(jax.random.key(seed + 1), C)
+    rngs = mesh.shard_clients(jax.random.key_data(keys))
+    return batches, weights, rngs

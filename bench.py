@@ -1,0 +1,70 @@
+"""Headline benchmark: BERT-base federated fine-tune throughput per chip.
+
+Runs the compiled federated round program (every client's 1-epoch AdamW
+fine-tune + FedAvg psum in one XLA program) on the available devices and
+reports training samples/sec/chip.
+
+Baseline derivation (BASELINE.md): the reference's serverless IMDB run —
+10 clients x 20 rounds x 100 samples, 40 min wall (All_graphs_IMDB_dataset
+.ipynb cell 15, 10-worker serverless latency) — is 20_000 samples / 2_400 s
+= 8.33 samples/sec on its CPU host. ``vs_baseline`` is the speedup over that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_SAMPLES_PER_SEC = 20_000 / 2_400.0  # 8.33, see docstring
+
+BATCH = 32  # reference batch size (server_IID_IMDB.py:96-99)
+SEQ = 128
+STEPS = 4  # local batches per client per round-program call
+WARMUP = 2
+ITERS = 8
+
+
+def main():
+    from bcfl_tpu.core.mesh import client_mesh
+    from bcfl_tpu.fed.client_step import build_programs
+    from bcfl_tpu.fed.synthetic import synthetic_round_inputs
+    from bcfl_tpu.models import build
+
+    n_dev = len(jax.devices())
+    num_clients = n_dev  # 1 client per chip
+    mesh = client_mesh(num_clients)
+    model = build("bert-base", num_labels=2)
+
+    ids0 = jnp.ones((2, SEQ), jnp.int32)
+    params = model.init(jax.random.key(0), ids0, ids0)["params"]
+    progs = build_programs(model, mesh)
+    batches, weights, rngs = synthetic_round_inputs(
+        mesh, steps=STEPS, batch=BATCH, seq=SEQ, vocab_size=30_000)
+
+    for _ in range(WARMUP):
+        p, stats = progs.server_round(params, None, batches, weights, rngs)
+        jax.block_until_ready(p)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, stats = progs.server_round(params, None, batches, weights, rngs)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    samples = ITERS * num_clients * STEPS * BATCH
+    sps_chip = samples / dt / n_dev
+    print(json.dumps({
+        "metric": "bert-base_fed_finetune_samples_per_sec_per_chip",
+        "value": round(sps_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_chip / REFERENCE_SAMPLES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

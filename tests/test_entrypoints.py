@@ -1,0 +1,73 @@
+"""Entrypoints: presets match the SURVEY.md §2.1 matrix; CLI smoke run; the
+driver graft hooks compile and execute."""
+
+import subprocess
+import sys
+
+import pytest
+
+from bcfl_tpu.entrypoints import build_presets, get_preset, list_presets, run
+
+
+def test_preset_matrix():
+    p = build_presets()
+    assert len(p) >= 13
+    # server_IID_IMDB.py row: biobert, 2 labels, 20 clients, 20 rounds, IID 100
+    c = p["server_iid_imdb"]
+    assert (c.mode, c.model, c.num_labels, c.num_clients, c.num_rounds) == (
+        "server", "biobert-base", 2, 20, 20)
+    assert c.partition.kind == "iid" and c.partition.iid_samples == 100
+    # serverless_NonIID_IMDB.py row: albert, 300k/240 trailing, unweighted
+    c = p["serverless_noniid_imdb"]
+    assert c.mode == "serverless" and not c.weighted_agg
+    assert (c.partition.stride, c.partition.train_span, c.partition.test_mode) == (
+        300, 240, "trailing")
+    # medical NonIID: 500i/400 fixed test slice
+    c = p["serverless_noniid_medical"]
+    assert (c.partition.stride, c.partition.train_span, c.partition.test_span,
+            c.partition.test_mode) == (500, 400, 400, "fixed")
+    # BC-FL preset wires ledger + pagerank + async together
+    c = p["bcfl_async_pagerank"]
+    assert c.ledger.enabled and c.sync == "async"
+    assert c.topology.anomaly_filter == "pagerank"
+
+
+def test_hf_variant_sets_checkpoint():
+    c = get_preset("serverless_noniid_imdb", hf=True)
+    assert c.hf_checkpoint == "albert-base-v2"
+    assert c.tokenizer == "albert-base-v2"
+
+
+def test_unknown_preset():
+    with pytest.raises(KeyError):
+        get_preset("nope")
+
+
+def test_smoke_preset_runs():
+    res = run(get_preset("smoke"), verbose=False)
+    assert len(res.metrics.rounds) == 2
+    assert res.metrics.rounds[-1].global_acc is not None
+
+
+def test_cli_smoke():
+    out = subprocess.run(
+        [sys.executable, "-m", "bcfl_tpu.entrypoints", "--preset", "smoke",
+         "--rounds", "1"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "global_accuracies" in out.stdout
+
+
+def test_graft_entry_hooks():
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as g
+
+    import jax
+
+    fn, args = g.entry()
+    logits = jax.jit(fn)(*args)
+    assert logits.shape == (8, 2)
+    g.dryrun_multichip(len(jax.devices()))

@@ -146,6 +146,9 @@ class FedConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # rounds; 0 = off
     eval_every: int = 1
+    # jax.profiler trace output dir (TensorBoard/Perfetto); None = off.
+    # The reference's only profiling is psutil+wall-clock (SURVEY.md §5).
+    profile_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.mode not in ("server", "serverless"):

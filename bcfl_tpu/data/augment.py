@@ -1,0 +1,225 @@
+"""Synthetic data augmentation (C20).
+
+The reference ships pre-generated augmented CSVs under
+``Dataset/Augmeted_datasets/`` — CTGAN, GaussianCopula, and random-shuffle
+variants of the self-driving-car sentiment set (SURVEY.md §2.2 C20) produced
+offline with the SDV library. Here augmentation is a live, seeded capability
+over any :class:`~bcfl_tpu.data.datasets.TextDataset`:
+
+- ``shuffle``  — label-preserving word-order shuffles (the reference's
+  random-shuffle CSV),
+- ``markov``   — per-class bigram Markov chains sampled into new documents
+  (the generative CTGAN-class capability, text-native),
+- ``copula``   — Gaussian-copula sampling over per-document token-frequency
+  feature vectors, decoded back to text by nearest-frequency vocabulary draw
+  (the GaussianCopula-class capability).
+
+All numpy, host-side, deterministic under one seed — augmentation happens
+before tokenization so the TPU pipeline is unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from bcfl_tpu.data.datasets import TextDataset
+
+METHODS = ("shuffle", "markov", "copula")
+
+
+def _split_words(text: str) -> List[str]:
+    return text.split()
+
+
+def shuffle_texts(texts: List[str], rng: np.random.Generator) -> List[str]:
+    out = []
+    for t in texts:
+        w = _split_words(t)
+        rng.shuffle(w)
+        out.append(" ".join(w))
+    return out
+
+
+def _markov_tables(texts: List[str]):
+    """Bigram transition table + start distribution for one class."""
+    starts: Dict[str, int] = defaultdict(int)
+    trans: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    lengths = []
+    for t in texts:
+        w = _split_words(t)
+        if not w:
+            continue
+        lengths.append(len(w))
+        starts[w[0]] += 1
+        for a, b in zip(w, w[1:]):
+            trans[a][b] += 1
+    return starts, trans, lengths or [8]
+
+
+def _sample_markov(starts, trans, lengths, rng: np.random.Generator) -> str:
+    skeys = list(starts)
+    sp = np.array([starts[k] for k in skeys], np.float64)
+    word = skeys[rng.choice(len(skeys), p=sp / sp.sum())]
+    n = int(rng.choice(lengths))
+    out = [word]
+    for _ in range(n - 1):
+        nxt = trans.get(word)
+        if not nxt:
+            break
+        keys = list(nxt)
+        p = np.array([nxt[k] for k in keys], np.float64)
+        word = keys[rng.choice(len(keys), p=p / p.sum())]
+        out.append(word)
+    return " ".join(out)
+
+
+def _copula_sample(texts: List[str], n: int, rng: np.random.Generator,
+                   vocab_cap: int = 256) -> List[str]:
+    """Gaussian copula over token-count feature vectors: estimate the
+    empirical marginals + correlation of per-document counts for the class's
+    top-``vocab_cap`` tokens, draw correlated normals, map back through the
+    marginal quantiles, and emit each token ``count`` times (order by
+    frequency — bag-of-words synthesis, like the reference's tabular SDV
+    usage applied to text)."""
+    vocab: Dict[str, int] = defaultdict(int)
+    for t in texts:
+        for w in _split_words(t):
+            vocab[w] += 1
+    top = sorted(vocab, key=vocab.get, reverse=True)[:vocab_cap]
+    if not top:
+        return [""] * n
+    idx = {w: i for i, w in enumerate(top)}
+    X = np.zeros((len(texts), len(top)), np.float64)
+    for r, t in enumerate(texts):
+        for w in _split_words(t):
+            if w in idx:
+                X[r, idx[w]] += 1
+    # gaussianize the rank (copula) marginals, estimate correlation
+    U = (np.argsort(np.argsort(X, axis=0), axis=0) + 0.5) / len(texts)
+    Zn = _norm_ppf(np.clip(U, 1e-4, 1 - 1e-4))
+    C = np.corrcoef(Zn, rowvar=False)
+    C = np.atleast_2d(np.nan_to_num(C, nan=0.0))
+    np.fill_diagonal(C, 1.0)
+    # nearest PSD: clip eigenvalues before the Cholesky
+    vals, vecs = np.linalg.eigh(C)
+    C = (vecs * np.maximum(vals, 1e-6)) @ vecs.T
+    L = np.linalg.cholesky(C + 1e-9 * np.eye(len(top)))
+    draws = rng.standard_normal((n, len(top))) @ L.T
+    # map correlated normals back through the empirical marginal quantiles
+    Xs = np.sort(X, axis=0)
+    u = _norm_cdf(draws)
+    pos = np.clip((u * (len(texts) - 1)).astype(int), 0, len(texts) - 1)
+    counts = Xs[pos, np.arange(len(top))[None, :]]
+    out = []
+    for r in range(n):
+        words = []
+        for j, w in enumerate(top):
+            words.extend([w] * int(round(counts[r, j])))
+        rng.shuffle(words)
+        out.append(" ".join(words) if words else top[0])
+    return out
+
+
+def _norm_cdf(x: np.ndarray) -> np.ndarray:
+    from math import sqrt
+
+    try:
+        from scipy.special import ndtr
+
+        return ndtr(x)
+    except ImportError:
+        import math
+
+        return np.vectorize(lambda v: 0.5 * (1 + math.erf(v / sqrt(2))))(x)
+
+
+def _norm_ppf(u: np.ndarray) -> np.ndarray:
+    try:
+        from scipy.special import ndtri
+
+        return ndtri(u)
+    except ImportError:
+        # Acklam's rational approximation — |rel err| < 1.15e-9, plenty for
+        # rank gaussianization
+        a = [-3.969683028665376e+01, 2.209460984245205e+02,
+             -2.759285104469687e+02, 1.383577518672690e+02,
+             -3.066479806614716e+01, 2.506628277459239e+00]
+        b = [-5.447609879822406e+01, 1.615858368580409e+02,
+             -1.556989798598866e+02, 6.680131188771972e+01,
+             -1.328068155288572e+01]
+        c = [-7.784894002430293e-03, -3.223964580411365e-01,
+             -2.400758277161838e+00, -2.549732539343734e+00,
+             4.374664141464968e+00, 2.938163982698783e+00]
+        d = [7.784695709041462e-03, 3.224671290700398e-01,
+             2.445134137142996e+00, 3.754408661907416e+00]
+        u = np.asarray(u, np.float64)
+        out = np.empty_like(u)
+        lo, hi = 0.02425, 1 - 0.02425
+        low, high = u < lo, u > hi
+        mid = ~(low | high)
+        q = np.sqrt(-2 * np.log(np.where(low, u, 0.5)))
+        out[low] = (((((c[0]*q+c[1])*q+c[2])*q+c[3])*q+c[4])*q+c[5])[low] / \
+                   ((((d[0]*q+d[1])*q+d[2])*q+d[3])*q+1)[low]
+        q = u - 0.5
+        r = q * q
+        out[mid] = ((((((a[0]*r+a[1])*r+a[2])*r+a[3])*r+a[4])*r+a[5])*q)[mid] / \
+                   (((((b[0]*r+b[1])*r+b[2])*r+b[3])*r+b[4])*r+1)[mid]
+        q = np.sqrt(-2 * np.log(np.where(high, 1 - u, 0.5)))
+        out[high] = -((((((c[0]*q+c[1])*q+c[2])*q+c[3])*q+c[4])*q+c[5])[high] /
+                      ((((d[0]*q+d[1])*q+d[2])*q+d[3])*q+1)[high])
+        return out
+
+
+def augment_dataset(
+    ds: TextDataset,
+    method: str = "shuffle",
+    factor: float = 0.5,
+    seed: int = 42,
+) -> TextDataset:
+    """Return ``ds`` with ``factor * n_train`` synthetic rows appended to the
+    train split (class-balanced over the original label distribution)."""
+    if method not in METHODS:
+        raise ValueError(f"unknown augmentation {method!r}; have {METHODS}")
+    rng = np.random.default_rng(seed)
+    n_new = int(ds.n_train * factor)
+    by_class: Dict[int, List[str]] = defaultdict(list)
+    for t, y in zip(ds.train_texts, ds.train_labels):
+        by_class[int(y)].append(t)
+    labels = list(by_class)
+    probs = np.array([len(by_class[c]) for c in labels], np.float64)
+    probs = probs / probs.sum()
+
+    new_texts: List[str] = []
+    new_labels: List[int] = []
+    draw = rng.choice(len(labels), size=n_new, p=probs)
+    per_class = defaultdict(int)
+    for d in draw:
+        per_class[labels[d]] += 1
+
+    for c, cnt in per_class.items():
+        src = by_class[c]
+        if method == "shuffle":
+            picks = rng.choice(len(src), size=cnt)
+            new_texts.extend(shuffle_texts([src[i] for i in picks], rng))
+        elif method == "markov":
+            starts, trans, lengths = _markov_tables(src)
+            if not starts:
+                continue
+            new_texts.extend(
+                _sample_markov(starts, trans, lengths, rng) for _ in range(cnt))
+        else:  # copula
+            new_texts.extend(_copula_sample(src, cnt, rng))
+        new_labels.extend([c] * cnt)
+
+    return dataclasses.replace(
+        ds,
+        name=f"{ds.name}+{method}",
+        train_texts=list(ds.train_texts) + new_texts,
+        train_labels=np.concatenate(
+            [ds.train_labels,
+             np.asarray(new_labels, ds.train_labels.dtype)]),
+    )

@@ -31,6 +31,7 @@ _HF = {
     "albert-base": "albert-base-v2",
     "biobert-base": "dmis-lab/biobert-v1.1",
     "bert-base": "bert-base-uncased",
+    "clinical-bert": "emilyalsentzer/Bio_ClinicalBERT",
 }
 
 

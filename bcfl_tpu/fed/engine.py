@@ -46,8 +46,15 @@ from bcfl_tpu.data import (
 from bcfl_tpu.data.pipeline import central_eval_batches
 from bcfl_tpu.fed.client_step import FedPrograms, build_programs, _merge
 from bcfl_tpu.ledger import Ledger
-from bcfl_tpu.metrics import ResourceMonitor, RoundRecord, RunMetrics, model_size_gb
-from bcfl_tpu.models import TextClassifier, get_config, lora as lora_lib
+from bcfl_tpu.metrics import (
+    ResourceMonitor,
+    RoundRecord,
+    RunMetrics,
+    StepClock,
+    model_size_gb,
+    trace,
+)
+from bcfl_tpu.models import TextClassifier, lora as lora_lib
 from bcfl_tpu.topology import anomaly_filter, random_graph, reference_graph
 from bcfl_tpu.topology.graph import LatencyGraph
 
@@ -92,19 +99,23 @@ class FedEngine:
             self.model = TextClassifier(model_cfg)
             params = variables["params"]
         else:
-            model_cfg = get_config(
+            from bcfl_tpu.models import build as build_model
+
+            self.model = build_model(
                 cfg.model, num_labels=self.num_labels,
                 vocab_size=self.tokenizer.vocab_size,
             )
-            self.model = TextClassifier(model_cfg)
             ids = jnp.ones((2, cfg.seq_len), jnp.int32)
             params = self.model.init(
                 jax.random.fold_in(self.root_key, 2), ids, ids)["params"]
 
         if cfg.lora_rank > 0:
+            from bcfl_tpu.models import lora_targets
+
             self.frozen = params
             self.trainable0 = lora_lib.init_lora(
-                jax.random.fold_in(self.root_key, 3), params, cfg.lora_rank)
+                jax.random.fold_in(self.root_key, 3), params, cfg.lora_rank,
+                targets=lora_targets(cfg.model))
         else:
             self.frozen = None
             self.trainable0 = params
@@ -194,9 +205,14 @@ class FedEngine:
     # ------------------------------------------------------------------- run
 
     def run(self, resume: bool = False) -> RunResult:
+        with trace(self.cfg.profile_dir):
+            return self._run(resume)
+
+    def _run(self, resume: bool = False) -> RunResult:
         cfg = self.cfg
         monitor = ResourceMonitor()
         metrics = RunMetrics()
+        clock = self.clock = StepClock()
         start_round = 0
         trainable = self.trainable0
         stacked = None
@@ -220,19 +236,21 @@ class FedEngine:
 
         for rnd in range(start_round, cfg.num_rounds):
             t0 = time.time()
-            gate = self._participation(rnd)
-            mask = gate["mask"].astype(np.float32)
+            with clock.phase("control_plane"):
+                gate = self._participation(rnd)
+                mask = gate["mask"].astype(np.float32)
 
-            if cfg.sync == "async":
-                trainable, stacked, rec = self._async_round(
-                    rnd, trainable, stacked, mask, async_state)
-            elif cfg.mode == "server":
-                trainable, rec = self._server_round(rnd, trainable, mask)
-            elif cfg.faithful:
-                trainable, rec = self._faithful_round(rnd, trainable, mask)
-            else:
-                stacked, trainable, rec = self._serverless_round(
-                    rnd, stacked, trainable, mask)
+            with clock.phase("round_program"):
+                if cfg.sync == "async":
+                    trainable, stacked, rec = self._async_round(
+                        rnd, trainable, stacked, mask, async_state)
+                elif cfg.mode == "server":
+                    trainable, rec = self._server_round(rnd, trainable, mask)
+                elif cfg.faithful:
+                    trainable, rec = self._faithful_round(rnd, trainable, mask)
+                else:
+                    stacked, trainable, rec = self._serverless_round(
+                        rnd, stacked, trainable, mask)
 
             rec.mask = mask.tolist()
             rec.anomalies = list(gate["anomalies"])
@@ -246,18 +264,21 @@ class FedEngine:
             rec.wall_s = time.time() - t0
 
             if cfg.eval_every and (rnd + 1) % cfg.eval_every == 0:
-                loss, acc = self._global_eval(trainable)
-                rec.global_loss, rec.global_acc = loss, acc
-                # reference-style per-client local accuracy on each client's
-                # LOCAL TEST split (serverless_NonIID_IMDB.py:291-292; Flower
-                # client.evaluate server_IID_IMDB.py:176-179)
-                tb = self._test_batches(rnd)
-                if stacked is not None:
-                    s = self.progs.eval_clients(stacked, self.frozen, tb)
-                else:
-                    s = self.progs.eval_clients_global(trainable, self.frozen, tb)
-                s = np.asarray(s)
-                rec.local_acc = (s[:, 1] / np.maximum(s[:, 2], 1)).tolist()
+                with clock.phase("eval"):
+                    loss, acc = self._global_eval(trainable)
+                    rec.global_loss, rec.global_acc = loss, acc
+                    # reference-style per-client local accuracy on each
+                    # client's LOCAL TEST split (serverless_NonIID_IMDB.py
+                    # :291-292; Flower client.evaluate
+                    # server_IID_IMDB.py:176-179)
+                    tb = self._test_batches(rnd)
+                    if stacked is not None:
+                        s = self.progs.eval_clients(stacked, self.frozen, tb)
+                    else:
+                        s = self.progs.eval_clients_global(
+                            trainable, self.frozen, tb)
+                    s = np.asarray(s)
+                    rec.local_acc = (s[:, 1] / np.maximum(s[:, 2], 1)).tolist()
             metrics.rounds.append(rec)
 
             if cfg.checkpoint_dir and cfg.checkpoint_every and \
@@ -274,6 +295,7 @@ class FedEngine:
         params = _merge(trainable, self.frozen)
         metrics.model_size_gb = model_size_gb(params)
         metrics.resources = monitor.snapshot()
+        metrics.phases = clock.summary()
         if self.ledger is not None and len(self.ledger):
             metrics.ledger = self.ledger.payload_accounting()
             metrics.ledger["chain_ok"] = float(self.ledger.verify_chain() == -1)

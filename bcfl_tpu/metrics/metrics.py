@@ -70,6 +70,8 @@ class RunMetrics:
     model_size_gb: float = 0.0
     resources: Dict[str, float] = dataclasses.field(default_factory=dict)
     ledger: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # per-phase step timings from metrics.tracing.StepClock
+    phases: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
 
     @property
     def global_accuracies(self) -> List[float]:
@@ -83,6 +85,7 @@ class RunMetrics:
             "model_size_gb": self.model_size_gb,
             "resources": self.resources,
             "ledger": self.ledger,
+            "phases": self.phases,
             "global_accuracies": self.global_accuracies,
         }, indent=2)
 

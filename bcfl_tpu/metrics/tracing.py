@@ -1,0 +1,75 @@
+"""Tracing / profiling (SURVEY.md §5: the reference has none beyond coarse
+psutil+wall-clock — this subsystem is the rebuild's upgrade, kept optional).
+
+Three layers:
+
+- :class:`StepClock` — cheap host-side phase timing (data, train, aggregate,
+  eval per round) with mean/p50/p95 summaries; always on, no deps.
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace directory for the wrapped region.
+- :func:`annotate` — ``jax.profiler.TraceAnnotation`` wrapper so engine
+  phases show up as named spans inside device traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+class StepClock:
+    """Named phase timers: ``with clock.phase("train"): ...`` per round."""
+
+    def __init__(self):
+        self._times: Dict[str, List[float]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._times[name].append(time.perf_counter() - t0)
+
+    def record(self, name: str, seconds: float):
+        self._times[name].append(seconds)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        import numpy as np
+
+        out = {}
+        for name, xs in self._times.items():
+            a = np.asarray(xs)
+            out[name] = {
+                "count": int(a.size),
+                "total_s": float(a.sum()),
+                "mean_s": float(a.mean()),
+                "p50_s": float(np.percentile(a, 50)),
+                "p95_s": float(np.percentile(a, 95)),
+            }
+        return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """``jax.profiler`` trace of the wrapped region (no-op if ``log_dir`` is
+    falsy). View with TensorBoard's profile plugin or Perfetto."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span inside a device trace (safe no-op if profiling is off)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -33,18 +33,66 @@ _CONFIGS: Dict[str, EncoderConfig] = {
     "albert-base": EncoderConfig(vocab_size=30000, hidden_size=768, num_layers=12,
                                  num_heads=12, intermediate_size=3072,
                                  share_layers=True, embedding_size=128),
+    # emilyalsentzer/Bio_ClinicalBERT — cased BERT-base init'd from BioBERT
+    # (BASELINE.json configs[3] "ClinicalBERT Medical-Transcriptions")
+    "clinical-bert": EncoderConfig(vocab_size=28996, hidden_size=768,
+                                   num_layers=12, num_heads=12,
+                                   intermediate_size=3072),
 }
 
+_LLAMA_CONFIGS: Dict[str, "LlamaConfig"] = {}
 
-def get_config(name: str, **overrides) -> EncoderConfig:
-    if name not in _CONFIGS:
-        raise KeyError(f"unknown model {name!r}; have {sorted(_CONFIGS)}")
-    return dataclasses.replace(_CONFIGS[name], **overrides)
+
+def _llama_configs():
+    global _LLAMA_CONFIGS
+    if not _LLAMA_CONFIGS:
+        from bcfl_tpu.models.llama import LlamaConfig
+
+        _LLAMA_CONFIGS = {
+            # test/bench scale-down (GQA exercised: 4 heads / 2 kv heads)
+            "tiny-llama": LlamaConfig(vocab_size=8192, hidden_size=128,
+                                      num_layers=2, num_heads=4, num_kv_heads=2,
+                                      intermediate_size=384, max_position=512),
+            # Llama-2-7B (BASELINE.json configs[4]: LoRA fed fine-tune)
+            "llama2-7b": LlamaConfig(vocab_size=32000, hidden_size=4096,
+                                     num_layers=32, num_heads=32,
+                                     intermediate_size=11008,
+                                     max_position=4096),
+        }
+    return _LLAMA_CONFIGS
+
+
+def get_config(name: str, **overrides):
+    # encoder registry first: llama.py is only imported on an encoder miss,
+    # so encoder-only runs never depend on the llama module importing
+    if name in _CONFIGS:
+        return dataclasses.replace(_CONFIGS[name], **overrides)
+    if name in _llama_configs():
+        return dataclasses.replace(_llama_configs()[name], **overrides)
+    raise KeyError(
+        f"unknown model {name!r}; have "
+        f"{sorted(_CONFIGS) + sorted(_llama_configs())}")
 
 
 def list_models():
-    return sorted(_CONFIGS)
+    return sorted(_CONFIGS) + sorted(_llama_configs())
 
 
-def build(name: str, **overrides) -> TextClassifier:
-    return TextClassifier(get_config(name, **overrides))
+def build(name: str, **overrides):
+    """Build the named classifier; encoder and llama families share the
+    forward signature ``apply(vars, ids, mask, deterministic=...) -> logits``."""
+    cfg = get_config(name, **overrides)
+    if name not in _CONFIGS:
+        from bcfl_tpu.models.llama import LlamaClassifier
+
+        return LlamaClassifier(cfg)
+    return TextClassifier(cfg)
+
+
+def lora_targets(name: str):
+    """Module names whose kernels get LoRA adapters, per model family."""
+    if name not in _CONFIGS and name in _llama_configs():
+        from bcfl_tpu.models.llama import LORA_TARGETS
+
+        return LORA_TARGETS
+    return lora.DEFAULT_TARGETS

@@ -42,9 +42,12 @@ def init_lora(key: jax.Array, params, rank: int,
         if len(shape) == 2:
             fan_in, fan_out = shape
         elif len(shape) == 3:
-            if names[-2] == "out":  # [h, d, out]
+            # row-parallel output projections (DenseGeneral axis=(-2,-1))
+            # have kernel [heads, head_dim, out]; column-parallel qkv
+            # (features=(heads, head_dim)) have kernel [in, heads, head_dim]
+            if names[-2] in ("out", "o_proj"):
                 fan_in, fan_out = shape[0] * shape[1], shape[2]
-            else:  # qkv [in, h, d]
+            else:
                 fan_in, fan_out = shape[0], shape[1] * shape[2]
         else:
             continue

@@ -9,15 +9,18 @@ Two implementations behind one signature:
   ``/opt/skills/guides/pallas_guide.md``), used automatically on TPU backends
   when shapes allow; falls back to the XLA version elsewhere.
 
+Both support ``causal=True`` (decoder masking) computed from block indices —
+no dense ``[S, S]`` bias ever exists, which is what lets the Llama decoder
+(:mod:`bcfl_tpu.models.llama`) run at long context.
+
 The reference never needed this (it truncates at 512 tokens — SURVEY.md §5
 "long-context: absent"), but long-context is first-class here: this is the
-building block that scales classification/fine-tuning past the HF tokenizer
-cap, and ring attention in :mod:`bcfl_tpu.parallel` composes it across chips.
+building block that scales fine-tuning past the HF tokenizer cap, and ring
+attention in :mod:`bcfl_tpu.parallel` composes it across chips.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -33,31 +36,43 @@ def flash_attention_xla(
     v: jnp.ndarray,
     bias: Optional[jnp.ndarray] = None,  # broadcastable to [B, H, S, S]
     block_size: int = DEFAULT_BLOCK,
+    causal: bool = False,
 ) -> jnp.ndarray:
     """Online-softmax blockwise attention (Rabe & Staats / FlashAttention
     recurrence), scanning KV blocks so the full score matrix never exists."""
     B, H, S, D = q.shape
+    Sk = k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-    nb = max(S // block_size, 1)
-    bs = S // nb
-    if S % nb:
+    nb = max(Sk // block_size, 1)
+    bs = Sk // nb
+    if Sk % nb:
         # fall back to one block if the length doesn't tile evenly
-        nb, bs = 1, S
+        nb, bs = 1, Sk
 
     kb = k.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)  # [nb, B, H, bs, D]
     vb = v.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
     if bias is not None:
-        bias = jnp.broadcast_to(bias, (B, H, S, S)).astype(jnp.float32)
+        bias = jnp.broadcast_to(bias, (B, H, S, Sk)).astype(jnp.float32)
         bb = bias.reshape(B, H, S, nb, bs).transpose(3, 0, 1, 2, 4)  # [nb, B, H, S, bs]
     else:
         bb = jnp.zeros((nb, 1, 1, 1, bs), jnp.float32)
 
     qf = q.astype(jnp.float32) * scale
+    # causal alignment for Sq != Sk (suffix-decode pattern): query i sits at
+    # global position (Sk - S) + i
+    qpos = (Sk - S) + jnp.arange(S)[:, None]  # [S, 1]
+    kcol = jnp.arange(bs)[None, :]  # [1, bs]
+
+    NEG = -1e30  # large-negative instead of -inf: exp() underflows to 0
+    # without creating (-inf) - (-inf) NaN paths for fully-masked rows
 
     def step(carry, xs):
         acc, m, l = carry  # acc [B,H,S,D] f32; m,l [B,H,S,1]
-        kj, vj, bj = xs
+        kj, vj, bj, j = xs
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32)) + bj
+        if causal:
+            kpos = j * bs + kcol  # [S, bs] via broadcast
+            s = jnp.where((kpos > qpos)[None, None], NEG, s)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -67,25 +82,36 @@ def flash_attention_xla(
 
     init = (
         jnp.zeros((B, H, S, D), jnp.float32),
-        jnp.full((B, H, S, 1), -jnp.inf, jnp.float32),
+        jnp.full((B, H, S, 1), NEG, jnp.float32),
         jnp.zeros((B, H, S, 1), jnp.float32),
     )
-    (acc, m, l), _ = lax.scan(step, init, (kb, vb, bb))
+    (acc, m, l), _ = lax.scan(step, init, (kb, vb, bb, jnp.arange(nb)))
     return (acc / jnp.maximum(l, 1e-9)).astype(q.dtype)
 
 
-def flash_attention_pallas(q, k, v, bias=None, block_q: int = 256, block_k: int = 256):
+def flash_attention_pallas(q, k, v, bias=None, causal: bool = False,
+                           block_q: int = 256, block_k: int = 256):
     """TPU Pallas flash kernel; implemented in :mod:`bcfl_tpu.ops.pallas_flash`."""
     from bcfl_tpu.ops.pallas_flash import flash_attention as _pl
 
-    return _pl(q, k, v, bias, block_q=block_q, block_k=block_k)
+    # positional: custom_vjp functions don't accept keyword arguments
+    return _pl(q, k, v, bias, causal, block_q, block_k)
 
 
-def flash_attention(q, k, v, bias=None, block_size: int = DEFAULT_BLOCK):
-    """Dispatch: Pallas on TPU when available, XLA blockwise elsewhere."""
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    block_size: int = DEFAULT_BLOCK):
+    """Dispatch: Pallas on TPU when available, XLA blockwise elsewhere.
+
+    ``bias`` here is key-side only ([B, Sk] or [B, 1, 1, Sk]) so both paths
+    stay O(S) in memory; use :func:`flash_attention_xla` directly for an
+    arbitrary dense bias.
+    """
     try:
         if jax.default_backend() == "tpu":
-            return flash_attention_pallas(q, k, v, bias)
+            return flash_attention_pallas(q, k, v, bias, causal=causal)
     except Exception:
         pass
-    return flash_attention_xla(q, k, v, bias, block_size=block_size)
+    if bias is not None and bias.ndim == 2:
+        bias = bias[:, None, None, :]
+    return flash_attention_xla(q, k, v, bias, block_size=block_size,
+                               causal=causal)

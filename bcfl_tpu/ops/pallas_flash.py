@@ -1,0 +1,172 @@
+"""TPU Pallas flash-attention forward kernel.
+
+Blockwise online-softmax attention (the FlashAttention recurrence) tiled for
+the MXU: grid ``(B, H, Sq/bq, Sk/bk)``, with the running max / normalizer /
+accumulator living in VMEM scratch that persists across the (innermost) KV
+grid dimension. The full ``[S, S]`` score matrix never exists — O(S) memory.
+
+Masking, all computed from block indices (never a dense ``[S, S]`` bias):
+- key-side additive bias ``[B, Sk]`` (padding masks, what the encoder's
+  :func:`bcfl_tpu.ops.attention.attention_bias_from_mask` produces),
+- ``causal=True`` decoder masking (``kpos > qpos`` -> -1e30),
+- out-of-bounds masking of the padded tail when ``Sq``/``Sk`` don't tile
+  evenly into blocks.
+
+Differentiation: the kernel is wrapped in ``jax.custom_vjp`` whose backward
+pass recomputes via the pure-XLA blockwise implementation
+(:func:`bcfl_tpu.ops.flash.flash_attention_xla`) — numerically the same
+attention, so gradients are exact; a hand-written Pallas backward kernel is a
+later optimization.
+
+Kernel playbook: ``/opt/skills/guides/pallas_guide.md`` (grid/BlockSpec,
+VMEM scratch, ``@pl.when`` init/finalize pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative, not -inf: exp underflows to 0 without NaNs
+LANES = 128  # TPU lane width: scratch last dim must be 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, bq: int, bk: int,
+                sq: int, sk: int):
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0]  # [bq, D]
+    k = k_ref[0, 0]  # [bk, D]
+    v = v_ref[0, 0]  # [bk, D]
+    b = bias_ref[0]  # [bk]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [bq, bk]
+    s = s + b[None, :].astype(jnp.float32)
+
+    # block-index masking: padded tail keys + (optionally) the causal triangle
+    kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    dead = kpos >= sk
+    if causal:
+        # suffix alignment for Sq != Sk (decode pattern): query i sits at
+        # global position (sk - sq) + i — matches flash_attention_xla
+        qpos = (sk - sq) + pl.program_id(2) * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        dead = jnp.logical_or(dead, kpos > qpos)
+    s = jnp.where(dead, NEG_INF, s)
+
+    m_prev = m_ref[:, :1]  # [bq, 1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(dead, 0.0, p)  # exp(NEG-NEG)=1 on all-masked rows otherwise
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        out_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-9)
+        ).astype(out_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, key_bias, causal: bool,
+                      block_q: int, block_k: int):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, S)
+    bk = min(block_k, Sk)
+    grid = (B, H, pl.cdiv(S, bq), pl.cdiv(Sk, bk))
+    scale = 1.0 / (D ** 0.5)
+
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, sq=S, sk=Sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, bk), lambda b, h, qi, ki: (b, ki)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),      # acc
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running max
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running normalizer
+        ],
+    )(q, k, v, key_bias)
+
+
+def _normalize_bias(bias, B: int, Sk: int) -> jnp.ndarray:
+    """Accept ``[B, Sk]`` / ``[B, 1, 1, Sk]`` / None -> ``[B, Sk]`` f32."""
+    if bias is None:
+        return jnp.zeros((B, Sk), jnp.float32)
+    if bias.ndim == 4:
+        if bias.shape[1] != 1 or bias.shape[2] != 1:
+            raise ValueError(
+                "pallas flash attention supports key-side bias only "
+                f"([B,1,1,Sk]); got {bias.shape}")
+        bias = bias[:, 0, 0, :]
+    if bias.shape != (B, Sk):
+        raise ValueError(f"bias shape {bias.shape} != {(B, Sk)}")
+    return bias.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    block_q: int = 256, block_k: int = 256):
+    """[B, H, S, D] x3 (+ key bias [B, Sk]) -> [B, H, S, D]."""
+    key_bias = _normalize_bias(bias, q.shape[0], k.shape[2])
+    return _flash_fwd_pallas(q, k, v, key_bias, causal, block_q, block_k)
+
+
+def _vjp_fwd(q, k, v, bias, causal, block_q, block_k):
+    out = flash_attention(q, k, v, bias, causal, block_q, block_k)
+    return out, (q, k, v, bias)
+
+
+def _vjp_bwd(causal, block_q, block_k, res, g):
+    from bcfl_tpu.ops.flash import flash_attention_xla
+
+    q, k, v, bias = res
+    if bias is None:
+        def ref(q, k, v):
+            return flash_attention_xla(q, k, v, None, block_size=block_k,
+                                       causal=causal)
+
+        _, vjp = jax.vjp(ref, q, k, v)
+        return (*vjp(g), None)
+
+    def ref(q, k, v, b):
+        b4 = _normalize_bias(b, q.shape[0], k.shape[2])[:, None, None, :]
+        return flash_attention_xla(q, k, v, b4, block_size=block_k,
+                                   causal=causal)
+
+    _, vjp = jax.vjp(ref, q, k, v, bias)
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,9 @@
+"""Result figures (the reference notebooks' plot set, from live metrics)."""
+
+from bcfl_tpu.viz.plots import (  # noqa: F401
+    accuracy_curves,
+    grouped_bars,
+    info_passing_bars,
+    run_report,
+    sweep_report,
+)

@@ -1,0 +1,72 @@
+"""C19 (result figures) + C20 (synthetic augmentation) capabilities."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bcfl_tpu.data.augment import METHODS, augment_dataset
+from bcfl_tpu.data.datasets import load_dataset
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return load_dataset("synthetic", n_train=120, n_test=40, num_labels=3)
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_augment_grows_train_split(ds, method):
+    out = augment_dataset(ds, method=method, factor=0.5, seed=7)
+    assert out.n_train == ds.n_train + int(ds.n_train * 0.5)
+    assert out.n_test == ds.n_test  # test split untouched
+    assert len(out.train_texts) == len(out.train_labels)
+    assert set(np.unique(out.train_labels)) <= set(range(ds.num_labels))
+    # synthetic rows are non-empty text
+    assert all(isinstance(t, str) and t for t in out.train_texts[ds.n_train:])
+
+
+def test_augment_deterministic(ds):
+    a = augment_dataset(ds, "markov", factor=0.25, seed=11)
+    b = augment_dataset(ds, "markov", factor=0.25, seed=11)
+    assert a.train_texts == b.train_texts
+    c = augment_dataset(ds, "markov", factor=0.25, seed=12)
+    assert a.train_texts != c.train_texts
+
+
+def test_augment_label_distribution_roughly_preserved(ds):
+    out = augment_dataset(ds, "shuffle", factor=2.0, seed=3)
+    orig = np.bincount(ds.train_labels, minlength=3) / ds.n_train
+    new = np.bincount(out.train_labels[ds.n_train:], minlength=3) / (
+        out.n_train - ds.n_train)
+    assert np.abs(orig - new).max() < 0.15
+
+
+def test_augment_unknown_method(ds):
+    with pytest.raises(ValueError):
+        augment_dataset(ds, "ctgan2")
+
+
+def test_viz_figure_set(tmp_path):
+    from bcfl_tpu.metrics import RoundRecord, RunMetrics
+    from bcfl_tpu.viz import accuracy_curves, grouped_bars, run_report
+
+    m = RunMetrics()
+    for i in range(3):
+        m.rounds.append(RoundRecord(
+            round=i, train_loss=1.0 - 0.1 * i, train_acc=0.5 + 0.1 * i,
+            local_acc=[0.5, 0.6], global_acc=0.5 + 0.1 * i,
+            info_passing_sync_s=4.0, info_passing_async_s=1.0))
+    paths = run_report(m, str(tmp_path), name="t")
+    assert len(paths) == 2 and all(os.path.getsize(p) > 1000 for p in paths)
+
+    # direct figure APIs (reference cells 15/18/21 and 29)
+    fig = grouped_bars(["5", "10", "20"],
+                       {"server": [38, 41.8, 45.4],
+                        "serverless": [27.8, 40, 41.5]},
+                       ylabel="latency (min)", title="IMDB latency",
+                       path=str(tmp_path / "bars.png"))
+    assert os.path.getsize(tmp_path / "bars.png") > 1000
+    accuracy_curves({"serverless-IID": [0.7, 0.8, 0.93],
+                     "server-IID": [0.6, 0.7, 0.84]},
+                    path=str(tmp_path / "curves.png"))
+    assert os.path.getsize(tmp_path / "curves.png") > 1000

@@ -50,10 +50,19 @@ def test_smoke_preset_runs():
 
 
 def test_cli_smoke():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # -S skips sitecustomize (which dials the TPU tunnel at interpreter
+    # start and hangs when it is unreachable); PYTHONPATH restores the
+    # search path sitecustomize would have provided
+    site_pkgs = [p for p in sys.path if p.endswith("site-packages")]
+    env["PYTHONPATH"] = os.pathsep.join([repo] + site_pkgs)
     out = subprocess.run(
-        [sys.executable, "-m", "bcfl_tpu.entrypoints", "--preset", "smoke",
-         "--rounds", "1"],
-        capture_output=True, text=True, timeout=600,
+        [sys.executable, "-S", "-m", "bcfl_tpu.entrypoints",
+         "--preset", "smoke", "--rounds", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo,
     )
     assert out.returncode == 0, out.stderr
     assert "global_accuracies" in out.stdout

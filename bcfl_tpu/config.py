@@ -136,6 +136,9 @@ class FedConfig:
     # --- async scheduling ---
     async_buffer: int = 0  # aggregate when this many clients arrived (0 = num_clients)
     staleness_decay: float = 0.5  # weight = decay ** staleness
+    # server step size along the staleness-weighted mean client delta
+    # (FedBuff-style buffered aggregation)
+    async_server_lr: float = 1.0
 
     # --- sub-configs ---
     partition: PartitionConfig = dataclasses.field(default_factory=PartitionConfig)

@@ -67,6 +67,23 @@ class RunResult:
     ledger: Optional[Ledger]
 
 
+# Cached jitted tree helpers. Defined once at module level so they compile
+# once per shape signature — an inline ``jax.jit(lambda ...)`` built inside a
+# round body would retrace EVERY round, and an unjitted ``jax.tree.map`` of
+# arithmetic dispatches one op per leaf (hundreds of tiny device round-trips
+# on a tunnelled TPU).
+_tree_sub = jax.jit(lambda a, b: jax.tree.map(jnp.subtract, a, b))
+_tree_axpy = jax.jit(
+    lambda y, x, a: jax.tree.map(lambda yy, xx: yy + a * xx, y, x))
+_tree_select = jax.jit(
+    lambda s, b, p: jax.tree.map(
+        lambda x, y: jnp.where(p.reshape((-1,) + (1,) * (x.ndim - 1)) > 0, y, x),
+        s, b))
+_tree_wsum = jax.jit(
+    lambda ws, trees: jax.tree.map(
+        lambda *xs: sum(w * x for w, x in zip(ws, xs)), *trees))
+
+
 class FedEngine:
     def __init__(
         self,
@@ -188,19 +205,24 @@ class FedEngine:
         s = np.asarray(self.progs.eval_global(trainable, self.frozen, self.eval_batches))
         return float(s[0] / max(s[2], 1)), float(s[1] / max(s[2], 1))
 
-    def _ledger_verify(self, rnd: int, stacked) -> np.ndarray:
-        """Commit every client's update, then authenticate what 'arrived'
+    def _ledger_authenticate(self, rnd: int, host) -> np.ndarray:
+        """Authenticate what 'arrived' against the already-committed chain
         (tamper_hook simulates in-flight modification). Returns 0/1 auth mask."""
         C = self.cfg.num_clients
-        host = jax.device_get(stacked)
-        for c in range(C):
-            self.ledger.append(rnd, c, jax.tree.map(lambda x: x[c], host))
         shipped = self.tamper_hook(rnd, host) if self.tamper_hook else host
         auth = np.ones((C,), np.float32)
         for c in range(C):
             ok = self.ledger.authenticate(rnd, c, jax.tree.map(lambda x: x[c], shipped))
             auth[c] = 1.0 if ok else 0.0
         return auth
+
+    def _ledger_verify(self, rnd: int, stacked) -> np.ndarray:
+        """Commit every client's update, then authenticate. Returns auth mask."""
+        C = self.cfg.num_clients
+        host = jax.device_get(stacked)
+        for c in range(C):
+            self.ledger.append(rnd, c, jax.tree.map(lambda x: x[c], host))
+        return self._ledger_authenticate(rnd, host)
 
     # ------------------------------------------------------------------- run
 
@@ -222,6 +244,12 @@ class FedEngine:
             if restored is not None:
                 start_round, state, ledger_json = restored
                 start_round += 1
+                ck_seed = state.get("seed")
+                if ck_seed is not None and int(ck_seed) != cfg.seed:
+                    raise ValueError(
+                        f"checkpoint was written with seed {int(ck_seed)} but "
+                        f"config has seed {cfg.seed}: resuming would break the "
+                        "per-(client, round) RNG stream")
                 if state.get("stacked") is not None:
                     stacked = self.mesh.shard_clients(state["stacked"])
                 trainable = state["trainable"]
@@ -286,6 +314,9 @@ class FedEngine:
                 state = {
                     "trainable": jax.device_get(trainable),
                     "stacked": jax.device_get(stacked) if stacked is not None else None,
+                    # the RNG stream is derived deterministically from the
+                    # seed + round; storing the seed lets resume verify it
+                    "seed": np.int64(cfg.seed),
                 }
                 save_checkpoint(
                     cfg.checkpoint_dir, rnd, state,
@@ -334,12 +365,15 @@ class FedEngine:
         auth = self._ledger_verify(rnd, stacked)
         w = self._weights(mask * auth, n_ex)
         trainable = self.progs.collapse(stacked, w, trainable)
-        return trainable, self._stats_to_rec(rnd, stats)
+        rec = self._stats_to_rec(rnd, stats)
+        rec.auth = auth.tolist()
+        return trainable, rec
 
     def _serverless_round(self, rnd, stacked, prev_consensus, mask):
         batches, n_ex = self._round_batches(rnd)
         rngs = self._rngs(rnd)
         m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
+        auth = None
         if self.ledger is None:
             stacked, stats = self.progs.gossip_round(
                 stacked, self.frozen, batches, m, rngs)
@@ -352,44 +386,64 @@ class FedEngine:
             stacked = self.progs.mix_only(stacked, m, start)
         # consensus view for eval/checkpoint (mask-weighted mean)
         consensus = self.progs.collapse(stacked, m, prev_consensus)
-        return stacked, consensus, self._stats_to_rec(rnd, stats)
+        rec = self._stats_to_rec(rnd, stats)
+        if auth is not None:
+            rec.auth = auth.tolist()
+        return stacked, consensus, rec
 
     def _faithful_round(self, rnd, trainable, mask):
         """Reference-exact serverless semantics: clients sequentially mutate a
         shared model within the round, snapshots are averaged unweighted
-        (``serverless_NonIID_IMDB.py:284-297``). Host-sequential by nature."""
+        (``serverless_NonIID_IMDB.py:284-297``). Host-sequential by nature.
+
+        With the ledger on, each snapshot is committed as it is produced and
+        re-authenticated before aggregation — a tampered snapshot is excluded
+        exactly as in the parallel paths. An all-excluded round keeps the
+        round's starting params instead of zeroing the model."""
         cfg = self.cfg
         batches, n_ex = self._round_batches(rnd)
         host_b = jax.device_get(batches)
         keys = client_round_keys(
             jax.random.fold_in(self.root_key, 4), cfg.num_clients, rnd)
-        snapshots, all_stats = [], []
+        snapshots, host_snaps, all_stats = [], [], []
         shared = trainable
         for c in range(cfg.num_clients):
             cb = jax.tree.map(lambda x: jnp.asarray(x[c]), host_b)
             shared, stats = self.progs.single_update(shared, self.frozen, cb, keys[c])
             if self.ledger is not None:
-                self.ledger.append(rnd, c, jax.device_get(shared))
+                snap = jax.device_get(shared)
+                self.ledger.append(rnd, c, snap)
+                host_snaps.append(snap)
             snapshots.append(shared)
             all_stats.append(np.asarray(stats))
-        ws = mask / max(mask.sum(), 1.0)
-        avg = jax.tree.map(
-            lambda *xs: sum(w * x for w, x in zip(ws, xs)), *snapshots)
-        return avg, self._stats_to_rec(rnd, np.stack(all_stats))
+        rec = self._stats_to_rec(rnd, np.stack(all_stats))
+        w = np.asarray(mask, np.float32)
+        if self.ledger is not None:
+            stacked_host = jax.tree.map(lambda *xs: np.stack(xs), *host_snaps)
+            auth = self._ledger_authenticate(rnd, stacked_host)
+            rec.auth = auth.tolist()
+            w = w * auth
+        total = float(w.sum())
+        if total <= 0.0:
+            return trainable, rec
+        avg = _tree_wsum(jnp.asarray(w / total), snapshots)
+        return avg, rec
 
     # ------------------------------------------------------------------ async
 
     def _init_async_state(self) -> Dict:
         """Simulated network clock: per-client round duration = local compute
-        (proportional to examples) + transfer time to the aggregation point
-        over the latency graph (the quantity the notebooks call information
-        passing time)."""
+        (proportional to the client's example count, mean-normalized to 1) +
+        transfer time to the aggregation point over the latency graph (the
+        quantity the notebooks call information passing time)."""
         cfg = self.cfg
         times = self.graph.shortest_path_times(self._payload_gb())
         src = self.info_source
         transfer = np.array([
             times[c, src] if c != src else 0.0 for c in range(cfg.num_clients)])
-        compute = np.ones((cfg.num_clients,))  # uniform local-compute cost
+        _, n_ex = self._round_batches(0)
+        n_ex = np.asarray(n_ex, np.float64)
+        compute = n_ex / max(n_ex.mean(), 1e-9)  # relative local-compute cost
         duration = compute + transfer
         return {
             "duration": duration,
@@ -399,21 +453,37 @@ class FedEngine:
             "clock": 0.0,
         }
 
+    def _async_merge_scale(self, alpha, arrived, n_ex) -> float:
+        """sum(decayed weights) / sum(un-decayed weights) over the arrived
+        buffer — the factor that survives collapse's normalization, in (0, 1]:
+        1.0 when every arrival is fresh, ``staleness_decay ** s`` when a lone
+        arrival is ``s`` versions stale."""
+        if self.cfg.weighted_agg:
+            base = float(np.asarray(n_ex)[arrived].sum())
+        else:
+            base = float(len(arrived))
+        return float(alpha[arrived].sum() / max(base, 1e-9))
+
     def _async_round(self, rnd, trainable, stacked, mask, st):
         """One buffered-async aggregation event (FedBuff-style): the K
-        earliest-finishing clients merge, staleness-decayed; others keep
-        training on their stale base."""
+        earliest-finishing clients merge their local DELTAS, each decayed by
+        ``staleness_decay ** staleness``; the global takes an
+        ``async_server_lr`` step along the weighted-mean delta. Clients that
+        haven't arrived keep training on their stale base."""
         cfg = self.cfg
         K = cfg.async_buffer or cfg.num_clients
         if stacked is None:
             stacked = self.progs.broadcast(trainable)
+        base = stacked  # each client's round-start params (delta reference)
         batches, n_ex = self._round_batches(rnd)
         rngs = self._rngs(rnd)
         stacked, stats = self.progs.local_updates(
             stacked, self.frozen, batches, rngs)
+        rec = self._stats_to_rec(rnd, stats)
 
         if self.ledger is not None:
             auth = self._ledger_verify(rnd, stacked)
+            rec.auth = auth.tolist()
             mask = mask * auth
 
         # pick the K earliest arrivals among participating clients
@@ -425,31 +495,31 @@ class FedEngine:
         alpha = np.zeros((cfg.num_clients,), np.float32)
         for c in arrived:
             alpha[c] = cfg.staleness_decay ** max(int(staleness[c]), 0)
+        rec.async_alpha = alpha.tolist()
         if self.cfg.weighted_agg:
             alpha = alpha * n_ex
 
         if arrived:
-            merged = self.progs.collapse(
-                stacked, self.mesh.shard_clients(jnp.asarray(alpha)), trainable)
-            # server-style incremental merge: global <- (1-a) global + a merged
-            a = float(np.clip(alpha[arrived].sum() /
-                              (alpha[arrived].sum() + len(arrived)), 0.1, 0.9))
-            trainable = jax.tree.map(
-                lambda g, m: (1 - a) * g + a * m, trainable, merged)
+            deltas = _tree_sub(stacked, base)
+            zero = jax.tree.map(jnp.zeros_like, trainable)
+            # collapse is a weight-NORMALIZED mean (divides by sum(alpha)), so
+            # on its own the staleness decay would cancel out of the update
+            # magnitude; rescale by sum(alpha)/sum(un-decayed weights) so a
+            # stale delta really is applied smaller, FedBuff-style.
+            merged_delta = self.progs.collapse(
+                deltas, self.mesh.shard_clients(jnp.asarray(alpha)), zero)
+            scale = self._async_merge_scale(alpha, arrived, n_ex)
+            trainable = _tree_axpy(
+                trainable, merged_delta, cfg.async_server_lr * scale)
             # arrived clients pull the fresh global and restart
             pull = np.zeros((cfg.num_clients,), np.float32)
             pull[arrived] = 1.0
             pull_d = self.mesh.shard_clients(jnp.asarray(pull))
             bcast = self.progs.broadcast(trainable)
-            stacked = jax.jit(
-                lambda s, b, p: jax.tree.map(
-                    lambda x, y: jnp.where(
-                        p.reshape((-1,) + (1,) * (x.ndim - 1)) > 0, y, x), s, b)
-            )(stacked, bcast, pull_d)
+            stacked = _tree_select(stacked, bcast, pull_d)
             st["global_version"] += 1
             for c in arrived:
                 st["version"][c] = st["global_version"]
                 st["next_done"][c] = st["clock"] + st["duration"][c]
 
-        rec = self._stats_to_rec(rnd, stats)
         return trainable, stacked, rec

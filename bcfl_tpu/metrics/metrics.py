@@ -59,6 +59,12 @@ class RoundRecord:
     global_loss: Optional[float] = None
     mask: Optional[List[float]] = None
     anomalies: Optional[List[int]] = None
+    # ledger-authentication outcome per client (1 = update verified against
+    # the hash chain, 0 = rejected); None when the ledger is off
+    auth: Optional[List[float]] = None
+    # staleness-decayed merge weight per client for this aggregation event
+    # (async mode only)
+    async_alpha: Optional[List[float]] = None
     info_passing_sync_s: Optional[float] = None
     info_passing_async_s: Optional[float] = None
     wall_s: float = 0.0

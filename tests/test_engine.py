@@ -137,3 +137,93 @@ def test_all_tampered_round_keeps_model():
     after = jax.device_get(res.trainable)
     for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_allclose(a, b, atol=1e-7)
+
+
+def test_faithful_ledger_detects_tampering():
+    """Faithful (sequential shared-model) mode must authenticate snapshots
+    too: a tampered client is excluded from the end-of-round average and its
+    rejection is recorded (regression: faithful path skipped authentication)."""
+    import jax
+
+    def tamper_client0(rnd, host_tree):
+        out = jax.tree.map(lambda x: np.array(x, copy=True), host_tree)
+        first = jax.tree.leaves(out)[0]
+        first[0] = first[0] + 99.0
+        return out
+
+    cfg = _cfg(mode="serverless", faithful=True, num_clients=3, num_rounds=1,
+               ledger=LedgerConfig(enabled=True))
+    res = FedEngine(cfg, tamper_hook=tamper_client0).run()
+    rec = res.metrics.rounds[0]
+    assert rec.auth == [0.0, 1.0, 1.0]
+    assert res.ledger.verify_chain() == -1  # chain itself intact
+
+
+def test_faithful_all_masked_keeps_params():
+    """A faithful round where every client is excluded must keep the round's
+    starting params (regression: used to zero the model via mask/max(sum,1))."""
+    import jax
+
+    eng = FedEngine(_cfg(mode="serverless", faithful=True, num_clients=3,
+                         num_rounds=1))
+    out, rec = eng._faithful_round(0, eng.trainable0, np.zeros(3, np.float32))
+    for a, b in zip(jax.tree.leaves(jax.device_get(out)),
+                    jax.tree.leaves(jax.device_get(eng.trainable0))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_compute_cost_from_examples():
+    """The async network clock's local-compute term is proportional to each
+    client's example count (regression: was uniform np.ones)."""
+    eng = FedEngine(_cfg(sync="async", num_clients=4, num_rounds=1))
+    n_ex = np.array([10.0, 20.0, 30.0, 40.0])
+    eng._round_batches = lambda rnd: (None, n_ex)
+    st = eng._init_async_state()
+    transfer = np.array([
+        eng.graph.shortest_path_times(eng._payload_gb())[c, eng.info_source]
+        if c != eng.info_source else 0.0 for c in range(4)])
+    np.testing.assert_allclose(
+        st["duration"] - transfer, n_ex / n_ex.mean(), rtol=1e-6)
+
+
+def test_async_staleness_downweights_slow_client():
+    """A client whose simulated link is slow accumulates staleness; when it
+    finally arrives its merge weight is decay**staleness, not full weight."""
+    cfg = _cfg(sync="async", async_buffer=1, num_clients=3, num_rounds=1,
+               weighted_agg=False)
+    eng = FedEngine(cfg)
+    st = eng._init_async_state()
+    st["next_done"] = np.array([1e9, 1.0, 2.0])  # client 0 is very slow
+    mask = np.ones(3, np.float32)
+    trainable, stacked = eng.trainable0, None
+    for rnd in range(3):
+        trainable, stacked, rec = eng._async_round(
+            rnd, trainable, stacked, mask, st)
+    assert st["global_version"] == 3
+    assert st["version"][0] == 0  # never merged
+    # force the slow client to arrive next: staleness = 3
+    st["next_done"][0] = 0.0
+    _, _, rec = eng._async_round(3, trainable, stacked, mask, st)
+    decay = cfg.staleness_decay
+    assert rec.async_alpha[0] == pytest.approx(decay ** 3)
+    assert rec.async_alpha[1] == 0.0 and rec.async_alpha[2] == 0.0
+    assert st["version"][0] == st["global_version"]
+
+
+def test_async_merge_scale_shrinks_stale_step():
+    """The factor actually applied to the merged delta (collapse normalizes
+    weights away) must shrink with staleness: a lone stale arrival steps by
+    decay**staleness, fresh arrivals step at full strength."""
+    cfg = _cfg(sync="async", num_clients=3, weighted_agg=False)
+    eng = FedEngine(cfg)
+    n_ex = np.array([10.0, 10.0, 10.0])
+    fresh = np.array([1.0, 0.0, 0.0], np.float32)
+    stale = np.array([cfg.staleness_decay ** 3, 0.0, 0.0], np.float32)
+    assert eng._async_merge_scale(fresh, [0], n_ex) == pytest.approx(1.0)
+    assert eng._async_merge_scale(stale, [0], n_ex) == pytest.approx(
+        cfg.staleness_decay ** 3)
+    # example weighting: scale is decayed-weight share of the example mass
+    eng_w = FedEngine(_cfg(sync="async", num_clients=3, weighted_agg=True))
+    a = np.array([0.5 * 10.0, 1.0 * 20.0, 0.0], np.float32)  # alpha * n_ex
+    assert eng_w._async_merge_scale(a, [0, 1], np.array([10.0, 20.0, 5.0])) \
+        == pytest.approx((5.0 + 20.0) / 30.0)

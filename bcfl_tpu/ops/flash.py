@@ -21,6 +21,7 @@ attention in :mod:`bcfl_tpu.parallel` composes it across chips.
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -51,11 +52,23 @@ def flash_attention_xla(
 
     kb = k.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)  # [nb, B, H, bs, D]
     vb = v.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
-    if bias is not None:
+    # A key-side bias ([B, Sk], or 4-D with singleton head/query dims — what
+    # padding masks produce) stays in [B, Sk] form, blocked [nb, B, bs] and
+    # broadcast per KV block inside the scan: no [B, H, S, Sk] buffer ever
+    # exists, preserving O(S) memory. Only a genuinely dense per-(head, query)
+    # bias falls back to full materialization.
+    key_side = bias is not None and (
+        bias.ndim == 2
+        or (bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1))
+    if bias is None:
+        bb = jnp.zeros((nb, 1, 1, 1, bs), jnp.float32)
+    elif key_side:
+        kb2 = bias if bias.ndim == 2 else bias[:, 0, 0, :]
+        kb2 = jnp.broadcast_to(kb2, (B, Sk)).astype(jnp.float32)
+        bb = kb2.reshape(B, nb, bs).transpose(1, 0, 2)  # [nb, B, bs]
+    else:
         bias = jnp.broadcast_to(bias, (B, H, S, Sk)).astype(jnp.float32)
         bb = bias.reshape(B, H, S, nb, bs).transpose(3, 0, 1, 2, 4)  # [nb, B, H, S, bs]
-    else:
-        bb = jnp.zeros((nb, 1, 1, 1, bs), jnp.float32)
 
     qf = q.astype(jnp.float32) * scale
     # causal alignment for Sq != Sk (suffix-decode pattern): query i sits at
@@ -69,7 +82,9 @@ def flash_attention_xla(
     def step(carry, xs):
         acc, m, l = carry  # acc [B,H,S,D] f32; m,l [B,H,S,1]
         kj, vj, bj, j = xs
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32)) + bj
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj.astype(jnp.float32))
+        # bj is [B, bs] on the key-side path, [B/1, H/1, S/1, bs] on the dense
+        s = s + (bj[:, None, None, :] if bj.ndim == 2 else bj)
         if causal:
             kpos = j * bs + kcol  # [S, bs] via broadcast
             s = jnp.where((kpos > qpos)[None, None], NEG, s)
@@ -98,6 +113,9 @@ def flash_attention_pallas(q, k, v, bias=None, causal: bool = False,
     return _pl(q, k, v, bias, causal, block_q, block_k)
 
 
+_pallas_fallback_warned = False
+
+
 def flash_attention(q, k, v, bias=None, causal: bool = False,
                     block_size: int = DEFAULT_BLOCK):
     """Dispatch: Pallas on TPU when available, XLA blockwise elsewhere.
@@ -106,12 +124,20 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     stay O(S) in memory; use :func:`flash_attention_xla` directly for an
     arbitrary dense bias.
     """
-    try:
-        if jax.default_backend() == "tpu":
+    global _pallas_fallback_warned
+    if jax.default_backend() == "tpu":
+        try:
             return flash_attention_pallas(q, k, v, bias, causal=causal)
-    except Exception:
-        pass
-    if bias is not None and bias.ndim == 2:
-        bias = bias[:, None, None, :]
+        except (ValueError, NotImplementedError, TypeError,
+                jax.errors.JaxRuntimeError) as e:
+            # Expected degradations only (unsupported shape/bias, lowering
+            # gap); anything else propagates. Warn ONCE so a silently slower
+            # fallback never hides a kernel regression.
+            if not _pallas_fallback_warned:
+                _pallas_fallback_warned = True
+                warnings.warn(
+                    f"pallas flash kernel unavailable ({e!r}); falling back "
+                    "to the XLA blockwise implementation",
+                    RuntimeWarning, stacklevel=2)
     return flash_attention_xla(q, k, v, bias, block_size=block_size,
                                causal=causal)

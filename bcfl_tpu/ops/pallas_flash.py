@@ -1,25 +1,40 @@
-"""TPU Pallas flash-attention forward kernel.
+"""TPU Pallas flash-attention kernels — forward AND backward.
 
 Blockwise online-softmax attention (the FlashAttention recurrence) tiled for
 the MXU: grid ``(B, H, Sq/bq, Sk/bk)``, with the running max / normalizer /
 accumulator living in VMEM scratch that persists across the (innermost) KV
 grid dimension. The full ``[S, S]`` score matrix never exists — O(S) memory.
+The forward kernel additionally emits the log-sum-exp per query row
+(lane-padded ``[B, H, S, 128]``, the layout TPU Mosaic tiles cleanly), which
+is what makes a recompute-free backward possible.
+
+Backward = two Pallas kernels (the standard flash backward split):
+
+- ``dKV`` kernel, grid ``(B, H, Sk/bk, Sq/bq)``: for each KV block, rebuild
+  the probability block from (q, k, lse), accumulate ``dv += p^T dO``,
+  ``dk += ds^T q`` and the key-side bias gradient ``db += sum_q ds`` in VMEM
+  scratch over the inner query loop.
+- ``dQ`` kernel, grid ``(B, H, Sq/bq, Sk/bk)``: accumulates ``dq += ds k``
+  over the inner KV loop.
+
+Both recompute ``s`` from q/k (one extra matmul per block) instead of saving
+probabilities — O(S) memory in the backward too. ``D = rowsum(dO * O)`` is
+folded into the kernels from the saved output, so no XLA-side pass is needed.
 
 Masking, all computed from block indices (never a dense ``[S, S]`` bias):
 - key-side additive bias ``[B, Sk]`` (padding masks, what the encoder's
   :func:`bcfl_tpu.ops.attention.attention_bias_from_mask` produces),
-- ``causal=True`` decoder masking (``kpos > qpos`` -> -1e30),
-- out-of-bounds masking of the padded tail when ``Sq``/``Sk`` don't tile
-  evenly into blocks.
+- ``causal=True`` decoder masking with suffix alignment for ``Sq != Sk``
+  (query i sits at global position ``Sk - Sq + i`` — the decode pattern),
+- out-of-bounds masking of padded tail query rows and key columns when the
+  lengths don't tile evenly into blocks.
 
-Differentiation: the kernel is wrapped in ``jax.custom_vjp`` whose backward
-pass recomputes via the pure-XLA blockwise implementation
-(:func:`bcfl_tpu.ops.flash.flash_attention_xla`) — numerically the same
-attention, so gradients are exact; a hand-written Pallas backward kernel is a
-later optimization.
+On non-TPU backends every kernel runs in Pallas interpret mode, so CI
+exercises the exact kernel bodies on the CPU mesh (SURVEY.md §4's
+distributed-without-hardware strategy applied to kernels).
 
 Kernel playbook: ``/opt/skills/guides/pallas_guide.md`` (grid/BlockSpec,
-VMEM scratch, ``@pl.when`` init/finalize pattern).
+VMEM scratch, ``@pl.when`` init/finalize pattern, custom-VJP pattern).
 """
 
 from __future__ import annotations
@@ -33,10 +48,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30  # large-negative, not -inf: exp underflows to 0 without NaNs
-LANES = 128  # TPU lane width: scratch last dim must be 128
+LANES = 128  # TPU lane width: scratch/lse last dim must be 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, acc_ref, m_ref, l_ref,
+def _interpret() -> bool:
+    """Run kernels in interpret mode off-TPU (CPU CI) — same kernel bodies."""
+    return jax.default_backend() != "tpu"
+
+
+def _zero_oob_rows(x, start: int, limit: int):
+    """Zero rows of a ``[rows, D]`` block whose global index >= limit.
+
+    Out-of-range block reads are padded with unspecified values (NaN in
+    interpret mode); a padded row multiplied by a zero probability still
+    poisons a dot product (0 * NaN = NaN), so dead rows must be zeroed at
+    load, not just masked downstream."""
+    idx = start + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(idx < limit, x, jnp.zeros_like(x))
+
+
+# --------------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
                 *, scale: float, causal: bool, bq: int, bk: int,
                 sq: int, sk: int):
     ki = pl.program_id(3)
@@ -49,8 +84,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     q = q_ref[0, 0]  # [bq, D]
-    k = k_ref[0, 0]  # [bk, D]
-    v = v_ref[0, 0]  # [bk, D]
+    k = _zero_oob_rows(k_ref[0, 0], ki * bk, sk)  # [bk, D]
+    v = _zero_oob_rows(v_ref[0, 0], ki * bk, sk)  # [bk, D]
     b = bias_ref[0]  # [bk]
 
     s = jax.lax.dot_general(
@@ -89,10 +124,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, acc_ref, m_ref, l_ref,
         out_ref[0, 0] = (
             acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-9)
         ).astype(out_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _flash_fwd_pallas(q, k, v, key_bias, causal: bool,
                       block_q: int, block_k: int):
+    """Returns ``(out [B,H,S,D], lse [B,H,S,LANES] f32)``."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, S)
@@ -110,14 +147,207 @@ def _flash_fwd_pallas(q, k, v, key_bias, causal: bool,
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, bk), lambda b, h, qi, ki: (b, ki)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),      # acc
             pltpu.VMEM((bq, LANES), jnp.float32),  # running max
             pltpu.VMEM((bq, LANES), jnp.float32),  # running normalizer
         ],
+        interpret=_interpret(),
     )(q, k, v, key_bias)
+
+
+# -------------------------------------------------------------------- backward
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, db_ref, dk_acc, dv_acc, db_acc,
+                    *, scale: float, causal: bool, bq: int, bk: int,
+                    sq: int, sk: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+        db_acc[:] = jnp.zeros_like(db_acc)
+
+    q = _zero_oob_rows(q_ref[0, 0], qi * bq, sq)    # [bq, D]
+    k = k_ref[0, 0]    # [bk, D]
+    v = v_ref[0, 0]    # [bk, D]
+    o = _zero_oob_rows(o_ref[0, 0], qi * bq, sq)    # [bq, D]
+    do = _zero_oob_rows(do_ref[0, 0], qi * bq, sq)  # [bq, D]
+    b = bias_ref[0]    # [bk]
+    lse = lse_ref[0, 0][:, :1]  # [bq, 1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + b[None, :].astype(jnp.float32)
+    kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    qrow = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    # padded tail QUERY rows must be masked here: unlike the forward (where
+    # garbage rows land in the discarded output slice) they would otherwise
+    # contribute to the dk/dv/db accumulators
+    dead = jnp.logical_or(kpos >= sk, qrow >= sq)
+    if causal:
+        dead = jnp.logical_or(dead, kpos > (sk - sq) + qrow)
+    p = jnp.where(dead, 0.0, jnp.exp(s - lse))  # [bq, bk]
+
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bk, D]
+
+    dsum = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+        axis=-1, keepdims=True)  # [bq, 1] = rowsum(dO * O)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    # explicit re-mask: dp/dsum can carry NaN/Inf from padded tail reads and
+    # 0 * NaN = NaN would survive p's zeros
+    ds = jnp.where(dead, 0.0, p * (dp - dsum))  # [bq, bk] f32
+
+    dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [bk, D]
+    db_acc[0:1, :] = db_acc[0:1, :] + ds.sum(axis=0)[None, :]
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+        db_ref[0, 0] = db_acc[0:1, :].astype(db_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
+                   dq_ref, dq_acc,
+                   *, scale: float, causal: bool, bq: int, bk: int,
+                   sq: int, sk: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0, 0]
+    k = _zero_oob_rows(k_ref[0, 0], ki * bk, sk)
+    v = _zero_oob_rows(v_ref[0, 0], ki * bk, sk)
+    o = o_ref[0, 0]
+    do = do_ref[0, 0]
+    b = bias_ref[0]
+    lse = lse_ref[0, 0][:, :1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + b[None, :].astype(jnp.float32)
+    kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    dead = kpos >= sk
+    if causal:
+        qpos = (sk - sq) + qi * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        dead = jnp.logical_or(dead, kpos > qpos)
+    p = jnp.where(dead, 0.0, jnp.exp(s - lse))
+
+    dsum = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+        axis=-1, keepdims=True)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = jnp.where(dead, 0.0, p * (dp - dsum))
+
+    dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [bq, D]
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, key_bias, out, do, lse, causal: bool,
+                      block_q: int, block_k: int):
+    """Hand-written backward: returns ``(dq, dk, dv, db[B, Sk])``."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, S)
+    bk = min(block_k, Sk)
+    scale = 1.0 / (D ** 0.5)
+    nq = pl.cdiv(S, bq)
+    nk = pl.cdiv(Sk, bk)
+
+    kw = dict(scale=scale, causal=causal, bq=bq, bk=bk, sq=S, sk=Sk)
+    interp = _interpret()
+
+    dk, dv, db_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kw),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, bk), lambda b, h, ki, qi: (b, ki)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, ki, qi: (b, h, 0, ki)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, Sk), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((8, bk), jnp.float32),  # db row accumulator (8-sublane)
+        ],
+        interpret=interp,
+    )(q, k, v, key_bias, out, do, lse)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kw),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, bk), lambda b, h, qi, ki: (b, ki)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interp,
+    )(q, k, v, key_bias, out, do, lse)
+
+    db = db_h.sum(axis=(1, 2))  # [B, Sk]: bias is shared across heads/queries
+    return dq, dk, dv, db
+
+
+# ------------------------------------------------------------------ public API
 
 
 def _normalize_bias(bias, B: int, Sk: int) -> jnp.ndarray:
@@ -140,33 +370,23 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
                     block_q: int = 256, block_k: int = 256):
     """[B, H, S, D] x3 (+ key bias [B, Sk]) -> [B, H, S, D]."""
     key_bias = _normalize_bias(bias, q.shape[0], k.shape[2])
-    return _flash_fwd_pallas(q, k, v, key_bias, causal, block_q, block_k)
+    out, _ = _flash_fwd_pallas(q, k, v, key_bias, causal, block_q, block_k)
+    return out
 
 
 def _vjp_fwd(q, k, v, bias, causal, block_q, block_k):
-    out = flash_attention(q, k, v, bias, causal, block_q, block_k)
-    return out, (q, k, v, bias)
+    key_bias = _normalize_bias(bias, q.shape[0], k.shape[2])
+    out, lse = _flash_fwd_pallas(q, k, v, key_bias, causal, block_q, block_k)
+    return out, (q, k, v, bias, key_bias, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_k, res, g):
-    from bcfl_tpu.ops.flash import flash_attention_xla
-
-    q, k, v, bias = res
+    q, k, v, bias, key_bias, out, lse = res
+    dq, dk, dv, db = _flash_bwd_pallas(
+        q, k, v, key_bias, out, g, lse, causal, block_q, block_k)
     if bias is None:
-        def ref(q, k, v):
-            return flash_attention_xla(q, k, v, None, block_size=block_k,
-                                       causal=causal)
-
-        _, vjp = jax.vjp(ref, q, k, v)
-        return (*vjp(g), None)
-
-    def ref(q, k, v, b):
-        b4 = _normalize_bias(b, q.shape[0], k.shape[2])[:, None, None, :]
-        return flash_attention_xla(q, k, v, b4, block_size=block_k,
-                                   causal=causal)
-
-    _, vjp = jax.vjp(ref, q, k, v, bias)
-    return vjp(g)
+        return dq, dk, dv, None
+    return dq, dk, dv, db.astype(bias.dtype).reshape(bias.shape)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -89,3 +89,80 @@ def test_causal_flash_suffix_query_alignment():
                                causal=True)
     np.testing.assert_allclose(np.asarray(tail), np.asarray(full[:, :, -8:]),
                                atol=2e-5)
+
+
+def _all_avals(jaxpr):
+    """Every intermediate aval in a jaxpr, recursing into sub-jaxprs."""
+    out = []
+    for eqn in jaxpr.eqns:
+        out.extend(v.aval for v in eqn.outvars)
+        for p in eqn.params.values():
+            if hasattr(p, "jaxpr"):  # ClosedJaxpr
+                out.extend(_all_avals(p.jaxpr))
+            elif isinstance(p, (list, tuple)):
+                out.extend(a for x in p if hasattr(x, "jaxpr")
+                           for a in _all_avals(x.jaxpr))
+    return out
+
+
+def test_key_bias_path_never_materializes_dense_scores():
+    """A key-side bias ([B,1,1,Sk]) must ride the O(S) path: no intermediate
+    of the full [B,H,S,Sk] score size may exist in the program (regression:
+    the bias used to be broadcast dense)."""
+    B, H, S, D = 2, 4, 256, 16
+    q = jnp.ones((B, H, S, D), jnp.float32)
+    key_bias = jnp.zeros((B, 1, 1, S), jnp.float32)
+
+    jaxpr = jax.make_jaxpr(
+        lambda q, b: flash_attention_xla(q, q, q, b, block_size=64))(q, key_bias)
+    dense_size = B * H * S * S
+    big = [a for a in _all_avals(jaxpr.jaxpr)
+           if hasattr(a, "shape") and np.prod(a.shape, dtype=int) >= dense_size]
+    assert not big, f"dense-scores-sized intermediates found: {big}"
+
+
+def test_dense_bias_fallback_matches_dense_attention():
+    """An arbitrary per-(head, query) bias still works via the documented
+    dense fallback and matches plain attention."""
+    rng = np.random.default_rng(7)
+    B, H, S, D = 2, 2, 128, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+               for _ in range(3))
+    bias = jnp.asarray(rng.normal(size=(B, H, S, S)), jnp.float32)
+    dense = dot_product_attention(q, k, v, bias)
+    flash = flash_attention_xla(q, k, v, bias, block_size=32)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=3e-5)
+
+
+def test_dispatcher_narrow_fallback_warns_once(monkeypatch):
+    """If the Pallas kernel raises an expected error on a TPU backend, the
+    dispatcher warns ONCE and falls back to XLA; unexpected errors propagate."""
+    import warnings as _warnings
+
+    from bcfl_tpu.ops import flash as flash_mod
+
+    monkeypatch.setattr(flash_mod.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(flash_mod, "_pallas_fallback_warned", False)
+
+    def boom(*a, **kw):
+        raise ValueError("unsupported bias")
+
+    monkeypatch.setattr(flash_mod, "flash_attention_pallas", boom)
+    q = jnp.ones((1, 2, 64, 8), jnp.float32)
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        out1 = flash_mod.flash_attention(q, q, q)
+        out2 = flash_mod.flash_attention(q, q, q)
+    assert sum(issubclass(x.category, RuntimeWarning) for x in w) == 1
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+    def unexpected(*a, **kw):
+        raise KeyError("bug in kernel")
+
+    monkeypatch.setattr(flash_mod, "flash_attention_pallas", unexpected)
+    try:
+        flash_mod.flash_attention(q, q, q)
+    except KeyError:
+        pass
+    else:
+        raise AssertionError("unexpected error type must propagate")

@@ -1,2 +1,9 @@
-from bcfl_tpu.core.mesh import ClientMesh, client_mesh  # noqa: F401
+from bcfl_tpu.core.mesh import (  # noqa: F401
+    ClientMesh,
+    client_mesh,
+    distributed_init,
+    fed_tp_mesh,
+    pod_client_mesh,
+    pod_devices,
+)
 from bcfl_tpu.core.prng import client_round_keys, fold_round  # noqa: F401

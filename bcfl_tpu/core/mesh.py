@@ -73,6 +73,87 @@ class ClientMesh:
         return np.arange(self.num_clients)
 
 
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Gated ``jax.distributed.initialize`` for multi-host pods.
+
+    The reference has no cross-machine communication at all — Flower runs as
+    a single-process Ray simulation (SURVEY.md §2.5). Here multi-host is the
+    DCN story: call this once per host process before any backend use, then
+    build meshes from :func:`pod_devices`. Parameters default to the
+    ``BCFL_COORDINATOR`` / ``BCFL_NUM_PROCESSES`` / ``BCFL_PROCESS_ID`` env
+    vars; a single-process setting (the common case, and every CI run) is a
+    no-op returning False.
+    """
+    import os
+
+    if num_processes is None:
+        num_processes = int(os.environ.get("BCFL_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return False
+    if process_id is None:
+        pid = os.environ.get("BCFL_PROCESS_ID")
+        if pid is None:
+            # defaulting to 0 would make EVERY host register as process 0 and
+            # hang the coordinator barrier with no useful error
+            raise ValueError(
+                "multi-process init needs a distinct process_id per host: "
+                "pass process_id= or set BCFL_PROCESS_ID")
+        process_id = int(pid)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address
+        or os.environ.get("BCFL_COORDINATOR"),
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def pod_devices() -> list:
+    """Global devices ordered hosts-major (DCN-outermost).
+
+    Laying the 1-D ``clients`` axis over this order means: FedAvg ``psum``
+    reduces over ICI within each host before crossing DCN once, and ring
+    gossip ``ppermute`` neighbors are intra-host except a single DCN hop per
+    host boundary — the layout rule 'collectives ride ICI, not DCN'.
+    Single-process: plain ``jax.devices()``.
+    """
+    if jax.process_count() == 1:
+        return list(jax.devices())
+    from jax.experimental import mesh_utils
+
+    per_host = jax.device_count() // jax.process_count()
+    # granule = PROCESS (host), not TPU slice: a multi-host single-slice pod
+    # (e.g. v4-16: 2 hosts, one slice) has process_count() granules of
+    # per-host devices, and CPU multi-process rigs have no slice_index at all
+    grid = mesh_utils.create_hybrid_device_mesh(
+        (per_host,), (jax.process_count(),), process_is_granule=True)
+    return list(grid.reshape(-1))
+
+
+def pod_client_mesh(num_clients: int) -> ClientMesh:
+    """clients mesh spanning every host in the pod (see :func:`pod_devices`)."""
+    return client_mesh(num_clients, devices=pod_devices())
+
+
+def fed_tp_mesh(client_shards: int, tp: int,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D ``(clients, tp)`` mesh: each client spans ``tp`` chips for
+    megatron tensor parallelism (``bcfl_tpu.models.llama.tp_specs``), clients
+    are parallel across the first axis. tp is innermost so a client's
+    tensor-parallel collectives ride adjacent-ICI links.
+    Used by :mod:`bcfl_tpu.parallel.fed_tp`."""
+    devices = list(devices) if devices is not None else pod_devices()
+    need = client_shards * tp
+    if len(devices) < need:
+        raise ValueError(
+            f"fed_tp_mesh needs {need} devices ({client_shards} client shards"
+            f" x tp={tp}), have {len(devices)}")
+    return Mesh(np.asarray(devices[:need]).reshape(client_shards, tp),
+                (CLIENT_AXIS, "tp"))
+
+
 def client_mesh(
     num_clients: int,
     devices: Optional[Sequence[jax.Device]] = None,

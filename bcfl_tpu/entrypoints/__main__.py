@@ -45,7 +45,18 @@ def main(argv=None):
                     help="enable the hash-chained weight ledger (BC-FL)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=None)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. 'cpu' for the virtual "
+                         "host mesh). The JAX_PLATFORMS env var is NOT enough "
+                         "on hosts whose site hooks pin a platform at "
+                         "interpreter start; this flag wins because it sets "
+                         "the config before any backend initializes")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     cfg = get_preset(args.preset, hf=args.hf)
     simple = {

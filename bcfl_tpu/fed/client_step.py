@@ -79,6 +79,34 @@ def make_loss_fn(model) -> Callable:
     return loss_fn
 
 
+def make_local_train(tx, loss_fn) -> Callable:
+    """One client's local round: fresh optimizer state (reference semantics,
+    ``server_IID_IMDB.py:109``), ``lax.scan`` over static-shape batches.
+    ``(trainable, frozen, batches, rng) -> (trainable, [loss*n, correct, n])``.
+    Shared by the 1-D clients mesh programs and the clients x tp composition
+    (:mod:`bcfl_tpu.parallel.fed_tp`)."""
+
+    def local_train(trainable, frozen, batches, rng):
+        opt_state = tx.init(trainable)
+        steps = batches["ids"].shape[0]
+        step_rngs = jax.random.split(rng, steps)
+
+        def step(carry, xs):
+            t, opt = carry
+            batch, r = xs
+            (loss, (correct, n)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(t, frozen, batch, r)
+            updates, opt = tx.update(grads, opt, t)
+            t = optax.apply_updates(t, updates)
+            return (t, opt), jnp.stack([loss * n, correct, n])
+
+        (trainable, _), stats = lax.scan(
+            step, (trainable, opt_state), (batches, step_rngs))
+        return trainable, stats.sum(axis=0)
+
+    return local_train
+
+
 @dataclasses.dataclass
 class FedPrograms:
     """Compiled round/eval programs bound to one (model, mesh, optimizer)."""
@@ -122,24 +150,7 @@ def build_programs(
     shard = P("clients")
 
     # ---- one client's local round: fresh opt state, scan over batches ----
-    def local_train(trainable, frozen, batches, rng):
-        opt_state = tx.init(trainable)
-        steps = batches["ids"].shape[0]
-        step_rngs = jax.random.split(rng, steps)
-
-        def step(carry, xs):
-            t, opt = carry
-            batch, r = xs
-            (loss, (correct, n)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                t, frozen, batch, r
-            )
-            updates, opt = tx.update(grads, opt, t)
-            t = optax.apply_updates(t, updates)
-            return (t, opt), jnp.stack([loss * n, correct, n])
-
-        (trainable, _), stats = lax.scan(step, (trainable, opt_state), (batches, step_rngs))
-        total = stats.sum(axis=0)  # [loss*n, correct, n]
-        return trainable, total
+    local_train = make_local_train(tx, loss_fn)
 
     def _unstack_rng(r):
         # rngs arrive as stacked key-data uint32 [..., 2]; rebuild typed keys

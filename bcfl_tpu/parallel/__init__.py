@@ -8,3 +8,7 @@ from bcfl_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,
 )
+from bcfl_tpu.parallel.fed_tp import (  # noqa: F401
+    build_fed_tp_round,
+    stack_adapters,
+)

@@ -1,0 +1,83 @@
+"""Federated LoRA rounds over a 2-D ``clients x tp`` mesh.
+
+The 1-D programs in :mod:`bcfl_tpu.fed.client_step` give every client one
+device (or a stacked share of one). For models too large for a single chip —
+the BASELINE.json Llama LoRA config — each client instead spans ``tp`` chips:
+
+- the frozen base params carry megatron tensor-parallel shardings
+  (:func:`bcfl_tpu.models.llama.tp_specs`) over the ``tp`` axis and are
+  shared by every client (replicated over ``clients``),
+- the per-client LoRA adapter stacks carry a leading client dim sharded over
+  ``clients`` (adapters are small; they stay replicated over ``tp``),
+- batches are sharded over ``clients`` like the 1-D path.
+
+The whole round is ONE ``jit`` with GSPMD in/out shardings — XLA inserts the
+tp collectives inside each client's forward/backward and the cross-client
+all-reduce for the FedAvg mean. This is the TPU-native composition of the
+reference's two axes of scale (many clients x a big model), neither of which
+the reference itself has (single process, encoder-size models — SURVEY.md
+§2.4-2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bcfl_tpu.core.mesh import CLIENT_AXIS
+
+Tree = Any
+
+
+def build_fed_tp_round(
+    model,
+    mesh: Mesh,
+    frozen_specs: Tree,
+    optimizer: str = "adamw",
+    learning_rate: float = 5e-5,
+) -> Callable:
+    """Compile the clients x tp federated round.
+
+    ``frozen_specs``: PartitionSpec tree for the frozen base params (e.g.
+    ``tp_specs(frozen)``). Returns ``round_fn(stacked_adapters, frozen,
+    batches, rngs) -> (stacked_adapters, stats [C, 3])`` where the returned
+    adapters are the FedAvg mean re-broadcast to every client (all clients
+    start the next round from consensus, matching the 1-D server path).
+    """
+    # deferred: fed.client_step itself imports bcfl_tpu.parallel (collectives)
+    from bcfl_tpu.fed.client_step import (
+        make_local_train, make_loss_fn, make_optimizer)
+
+    tx = make_optimizer(optimizer, learning_rate)
+    local_train = make_local_train(tx, make_loss_fn(model))
+
+    def round_fn(stacked, frozen, batches, rngs):
+        def per_client(ad, b, r):
+            return local_train(ad, frozen, b, jax.random.wrap_key_data(r))
+
+        new, stats = jax.vmap(per_client)(stacked, batches, rngs)
+        avg = jax.tree.map(lambda x: x.mean(axis=0), new)
+        new_stacked = jax.tree.map(
+            lambda a, x: jnp.broadcast_to(a[None], x.shape), avg, new)
+        return new_stacked, stats
+
+    cl = NamedSharding(mesh, P(CLIENT_AXIS))
+    frozen_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), frozen_specs)
+    return jax.jit(
+        round_fn,
+        in_shardings=(cl, frozen_sh, cl, cl),
+        out_shardings=(cl, cl),
+    )
+
+
+def stack_adapters(mesh: Mesh, adapters: Tree, num_clients: int) -> Tree:
+    """Broadcast one adapter tree to a client-stacked, client-sharded tree."""
+    cl = NamedSharding(mesh, P(CLIENT_AXIS))
+    return jax.device_put(
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (num_clients,) + x.shape),
+            adapters),
+        cl)

@@ -18,4 +18,6 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# the checkout under test must always win over any installed copy of the
+# package (a stale non-editable `pip install .` would otherwise shadow it)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,92 @@
+"""clients x tp composition: a federated LoRA round where each client's
+forward/backward is tensor-parallel over a 'tp' mesh axis — the BASELINE.json
+Llama-LoRA config's sharding story, exercised on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcfl_tpu.core.mesh import (
+    client_mesh,
+    distributed_init,
+    fed_tp_mesh,
+    pod_client_mesh,
+    pod_devices,
+)
+from bcfl_tpu.models import build
+from bcfl_tpu.models.llama import LORA_TARGETS, tp_specs
+from bcfl_tpu.models import lora as lora_lib
+from bcfl_tpu.parallel.fed_tp import build_fed_tp_round, stack_adapters
+
+
+def test_distributed_init_single_process_noop():
+    assert distributed_init() is False
+    assert jax.process_count() == 1
+
+
+def test_pod_devices_single_process():
+    assert pod_devices() == list(jax.devices())
+    assert pod_client_mesh(8).num_clients == 8
+
+
+def test_fed_tp_mesh_shape_and_validation():
+    mesh = fed_tp_mesh(4, 2)
+    assert mesh.axis_names == ("clients", "tp")
+    assert mesh.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        fed_tp_mesh(8, 2)  # 16 devices needed, 8 available
+
+
+def test_fed_tp_lora_round():
+    C, TP = 4, 2
+    mesh = fed_tp_mesh(C, TP)
+    model = build("tiny-llama", num_labels=2)
+
+    B, S = 4, 32
+    ids = jnp.ones((B, S), jnp.int32)
+    frozen = model.init(jax.random.key(0), ids, ids)["params"]
+    specs = tp_specs(frozen, axis="tp")
+    from jax.sharding import NamedSharding
+
+    frozen = jax.device_put(
+        frozen, jax.tree.map(lambda s: NamedSharding(mesh, s), specs))
+
+    adapters = lora_lib.init_lora(jax.random.key(1), frozen, rank=2,
+                                  targets=LORA_TARGETS)
+    stacked = stack_adapters(mesh, adapters, C)
+
+    rng = np.random.default_rng(0)
+    steps = 2
+    batches = {
+        "ids": jnp.asarray(rng.integers(0, 256, (C, steps, B, S)), jnp.int32),
+        "mask": jnp.ones((C, steps, B, S), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 2, (C, steps, B)), jnp.int32),
+        "example_mask": jnp.ones((C, steps, B), jnp.float32),
+    }
+    rngs = jax.random.key_data(jax.random.split(jax.random.key(2), C))
+
+    round_fn = build_fed_tp_round(model, mesh, specs, learning_rate=1e-3)
+    new_stacked, stats = round_fn(stacked, frozen, batches, rngs)
+    jax.block_until_ready(new_stacked)
+
+    assert np.asarray(stats).shape == (C, 3)
+    host = jax.device_get(new_stacked)
+    for leaf, leaf0 in zip(jax.tree.leaves(host),
+                           jax.tree.leaves(jax.device_get(stacked))):
+        # every client ends the round on the consensus average ...
+        for c in range(1, C):
+            np.testing.assert_allclose(leaf[c], leaf[0], rtol=1e-5)
+        # ... and training moved the adapters
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree.leaves(host),
+                        jax.tree.leaves(jax.device_get(stacked))))
+    assert moved
+
+
+def test_distributed_init_requires_process_id(monkeypatch):
+    monkeypatch.setenv("BCFL_NUM_PROCESSES", "2")
+    monkeypatch.delenv("BCFL_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="process_id"):
+        distributed_init()

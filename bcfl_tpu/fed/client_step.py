@@ -113,6 +113,7 @@ class FedPrograms:
 
     mesh: ClientMesh
     server_round: Callable  # (global_t, frozen, batches, weights, rngs) -> (global_t, metrics)
+    server_rounds: Callable  # R rounds in one program; batches/rngs leaves [R, C, ...]
     gossip_round: Callable  # (client_t, frozen, batches, mask, rngs) -> (client_t, metrics)
     eval_clients: Callable  # (client_t, frozen, batches) -> per-client [C, 3] stats
     eval_clients_global: Callable  # (global_t, frozen, batches) -> per-client [C, 3] stats
@@ -157,11 +158,13 @@ def build_programs(
         return jax.random.wrap_key_data(r)
 
     # ---- server mode: everyone trains from the SAME global trainable ----
+    # single source of truth for one FedAvg round; the per-round program and
+    # the scanned multi-round fast path below both apply exactly this body
     def server_shard(global_t, frozen, batches, weights, rngs):
-        def per_client(b, w, r):
+        def per_client(b, r):
             return local_train(global_t, frozen, b, _unstack_rng(r))
 
-        new_t, stats = jax.vmap(per_client)(batches, weights, rngs)
+        new_t, stats = jax.vmap(per_client)(batches, rngs)
         # all-masked round -> keep the round's starting params, don't zero them
         avg = masked_weighted_mean(new_t, weights, axis, fallback=global_t)
         return avg, stats
@@ -206,6 +209,33 @@ def build_programs(
             gossip_shard, mesh=jmesh,
             in_specs=(shard, repl, shard, shard, shard),
             out_specs=(shard, shard),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    # ---- multi-round fast path: R whole federated rounds in ONE program ----
+    # For sync FedAvg with static participation/data the per-round host
+    # round-trip is pure overhead (and on a tunnelled TPU it dominates: the
+    # replicated result tree re-crosses the link every call). Scanning the
+    # rounds on-device keeps params in HBM for the whole block. The engine
+    # keeps the per-round program (masks/ledger need the host between
+    # rounds); this is the bench/static-config path.
+    def server_rounds_shard(global_t, frozen, batches, weights, rngs):
+        def one_round(t, xs):
+            b, r = xs
+            return server_shard(t, frozen, b, weights, r)
+
+        # batches/rngs leaves are [R, Cl, ...] (round-leading, client dim
+        # sharded); scan consumes the leading round axis
+        return lax.scan(one_round, global_t, (batches, rngs))
+
+    rshard = P(None, "clients")
+    server_rounds = jax.jit(
+        shard_map(
+            server_rounds_shard, mesh=jmesh,
+            in_specs=(repl, repl, rshard, shard, rshard),
+            out_specs=(repl, rshard),
             check_vma=False,
         ),
         donate_argnums=(0,) if donate else (),
@@ -312,6 +342,7 @@ def build_programs(
     return FedPrograms(
         mesh=mesh,
         server_round=server_round,
+        server_rounds=server_rounds,
         gossip_round=gossip_round,
         eval_clients=eval_clients,
         eval_clients_global=eval_clients_global,

@@ -23,6 +23,11 @@ _CONFIGS: Dict[str, EncoderConfig] = {
     "tiny-albert": EncoderConfig(vocab_size=8192, hidden_size=128, num_layers=2,
                                  num_heads=2, intermediate_size=512,
                                  share_layers=True, embedding_size=64),
+    # mid-size encoder: real-data experiments on hosts without an
+    # accelerator (a BERT-base run is TPU-sized); same family, 4 layers
+    "small-bert": EncoderConfig(vocab_size=30522, hidden_size=512,
+                                num_layers=4, num_heads=8,
+                                intermediate_size=2048),
     # BERT-base family (BASELINE.json north-star model; biobert-v1.1 is a
     # cased BERT-base, vocab 28996 — reference server_IID_IMDB.py:48)
     "bert-base": EncoderConfig(vocab_size=30522, hidden_size=768, num_layers=12,

@@ -1,69 +1,175 @@
 """Headline benchmark: BERT-base federated fine-tune throughput per chip.
 
-Runs the compiled federated round program (every client's 1-epoch AdamW
-fine-tune + FedAvg psum in one XLA program) on the available devices and
-reports training samples/sec/chip.
+Times the on-device multi-round federated program (``server_rounds``: R whole
+FedAvg rounds — every client's AdamW fine-tune + the psum collective —
+scanned inside ONE XLA dispatch). One dispatch per timed block matters on a
+tunnelled TPU: the replicated param tree (~0.44 GB for BERT-base) re-crosses
+the link on every host round-trip, which dominated the r02 measurement
+(STEPS=4 per dispatch -> ~8.7 s/call of which <1 s was compute).
 
 Baseline derivation (BASELINE.md): the reference's serverless IMDB run —
 10 clients x 20 rounds x 100 samples, 40 min wall (All_graphs_IMDB_dataset
 .ipynb cell 15, 10-worker serverless latency) — is 20_000 samples / 2_400 s
 = 8.33 samples/sec on its CPU host. ``vs_baseline`` is the speedup over that.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+MFU: training FLOPs ~= 6 * params * tokens (fwd 2PD + bwd 4PD); peak is the
+chip's advertised bf16 matmul rate (v5e: 197 TFLOP/s).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
+A watchdog emits an error JSON line and exits if the backend wedges (the
+tunnel can hang indefinitely at init — r01 lost its perf evidence to an
+unguarded failure, and the r03 session saw multi-hour init hangs).
+
+Env knobs: BCFL_BENCH_TRACE=<dir> captures a jax.profiler trace of the timed
+block; BCFL_BENCH_ROUNDS/STEPS/ITERS override the shape.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-
-REFERENCE_SAMPLES_PER_SEC = 20_000 / 2_400.0  # 8.33, see docstring
+BASELINE_SAMPLES_PER_SEC = 20_000 / 2_400.0  # 8.33, see docstring
 
 BATCH = 32  # reference batch size (server_IID_IMDB.py:96-99)
 SEQ = 128
-STEPS = 4  # local batches per client per round-program call
-WARMUP = 2
-ITERS = 8
+ROUNDS = int(os.environ.get("BCFL_BENCH_ROUNDS", "8"))  # fed rounds / dispatch
+STEPS = int(os.environ.get("BCFL_BENCH_STEPS", "8"))  # local batches / round
+ITERS = int(os.environ.get("BCFL_BENCH_ITERS", "2"))  # timed dispatches
+STAGE_TIMEOUT_S = 1200.0  # per STAGE, reset on every stage transition
+
+PEAK_FLOPS = {  # bf16 peak matmul throughput per chip
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _error_json(stage: str, err: str):
+    _emit({
+        "metric": "bert-base_fed_finetune_samples_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+        "error": f"{stage}: {err[:400]}",
+    })
+
+
+class _Watchdog:
+    """Per-stage deadline: the timer restarts on every stage transition, so a
+    slow-but-progressing run is never killed — only a stage that makes no
+    progress for STAGE_TIMEOUT_S (e.g. a wedged tunnel at backend init)."""
+
+    def __init__(self, timeout_s: float):
+        self._timeout = timeout_s
+        self._timer = None
+        self.name = "start"
+
+    def stage(self, name: str):
+        self.name = name
+        self.cancel()
+        self._timer = threading.Timer(self._timeout, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self):
+        _error_json(self.name,
+                    f"stage made no progress within {self._timeout:.0f}s "
+                    "(wedged TPU tunnel?)")
+        os._exit(2)
+
+    def cancel(self):
+        if self._timer is not None:
+            self._timer.cancel()
 
 
 def main():
-    from bcfl_tpu.core.mesh import client_mesh
-    from bcfl_tpu.fed.client_step import build_programs
-    from bcfl_tpu.fed.synthetic import synthetic_round_inputs
-    from bcfl_tpu.models import build
+    watchdog = _Watchdog(STAGE_TIMEOUT_S)
+    watchdog.stage("backend-init")
 
-    n_dev = len(jax.devices())
-    num_clients = n_dev  # 1 client per chip
-    mesh = client_mesh(num_clients)
-    model = build("bert-base", num_labels=2)
+    try:
+        import jax
+        import jax.numpy as jnp
 
-    ids0 = jnp.ones((2, SEQ), jnp.int32)
-    params = model.init(jax.random.key(0), ids0, ids0)["params"]
-    progs = build_programs(model, mesh)
-    batches, weights, rngs = synthetic_round_inputs(
-        mesh, steps=STEPS, batch=BATCH, seq=SEQ, vocab_size=30_000)
+        from bcfl_tpu.core.mesh import client_mesh
+        from bcfl_tpu.fed.client_step import build_programs
+        from bcfl_tpu.fed.synthetic import synthetic_round_inputs
+        from bcfl_tpu.models import build
 
-    for _ in range(WARMUP):
-        p, stats = progs.server_round(params, None, batches, weights, rngs)
-        jax.block_until_ready(p)
+        devices = jax.devices()
+        n_dev = len(devices)
+        kind = devices[0].device_kind
+        peak = PEAK_FLOPS.get(kind)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        params, stats = progs.server_round(params, None, batches, weights, rngs)
-    jax.block_until_ready(params)
-    dt = time.perf_counter() - t0
+        watchdog.stage("build")
+        num_clients = n_dev  # 1 client per chip (BASELINE.json north star)
+        mesh = client_mesh(num_clients)
+        model = build("bert-base", num_labels=2)
 
-    samples = ITERS * num_clients * STEPS * BATCH
-    sps_chip = samples / dt / n_dev
-    print(json.dumps({
-        "metric": "bert-base_fed_finetune_samples_per_sec_per_chip",
-        "value": round(sps_chip, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps_chip / REFERENCE_SAMPLES_PER_SEC, 2),
-    }))
+        ids0 = jnp.ones((2, SEQ), jnp.int32)
+        # jitted init: unjitted flax init dispatches hundreds of host ops
+        # (minutes over the tunnel)
+        params = jax.jit(
+            lambda k: model.init(k, ids0, ids0)["params"])(jax.random.key(0))
+        jax.block_until_ready(params)
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        progs = build_programs(model, mesh, donate=True)
+
+        batches, weights, rngs = synthetic_round_inputs(
+            mesh, steps=STEPS, batch=BATCH, seq=SEQ, vocab_size=30_000)
+        # stack a round axis: [R, C, ...] (same data every round — this is a
+        # throughput bench, not a learning run)
+        rbatches = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (ROUNDS,) + x.shape), batches)
+        rrngs = jnp.broadcast_to(rngs[None], (ROUNDS,) + rngs.shape)
+
+        watchdog.stage("compile")
+        params, stats = progs.server_rounds(params, None, rbatches, weights, rrngs)
+        jax.block_until_ready(params)
+
+        watchdog.stage("measure")
+        trace_dir = os.environ.get("BCFL_BENCH_TRACE")
+        if trace_dir:
+            jax.profiler.start_trace(trace_dir)
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            params, stats = progs.server_rounds(
+                params, None, rbatches, weights, rrngs)
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        if trace_dir:
+            jax.profiler.stop_trace()
+
+        samples = ITERS * ROUNDS * num_clients * STEPS * BATCH
+        sps_chip = samples / dt / n_dev
+        flops = 6.0 * n_params * samples * SEQ
+        out = {
+            "metric": "bert-base_fed_finetune_samples_per_sec_per_chip",
+            "value": round(sps_chip, 2),
+            "unit": "samples/sec/chip",
+            "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC, 2),
+            "device": kind,
+            "params_m": round(n_params / 1e6, 1),
+            "steps_per_dispatch": ROUNDS * STEPS,
+            "wall_s": round(dt, 2),
+        }
+        if peak:
+            out["mfu_pct"] = round(100.0 * flops / dt / (peak * n_dev), 2)
+        watchdog.cancel()
+        _emit(out)
+    except Exception as e:  # noqa: BLE001 — evidence must survive any failure
+        watchdog.cancel()
+        _error_json(watchdog.name, f"{type(e).__name__}: {e}")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,208 @@
+"""Real-data results runner.
+
+Runs the Medical-Transcriptions experiments — the one reference dataset whose
+data ships on disk (``/root/reference/Dataset/{train,test}_file_mt.csv``,
+12,021/3,003 rows, 40 specialties; SURVEY.md C20) — through the two preset
+configurations whose published curves are BASELINE.md's Medical table:
+
+- ``server_iid_medical``       (reference ``server_iid_medical_transcirptions.py``)
+- ``serverless_noniid_medical``(reference ``Serverless_NonIID_Medical_transcriptions.py``)
+- plus the BC-FL extension (ledger + PageRank gating + async) the reference
+  only describes (README.md:10).
+
+Emits per-run ``results/<name>.json`` + figures and rewrites ``RESULTS.md``
+with the side-by-side against the reference's published numbers.
+
+Usage:
+    python scripts/run_results.py [--model small-bert] [--clients 10]
+        [--rounds 20] [--platform cpu] [--hf] [--out results]
+
+Zero-egress hosts cannot fetch the BioBERT checkpoint/tokenizer, so the
+default is fresh-init + hash tokenizer (documented in RESULTS.md); on a host
+with hub access pass ``--hf --model biobert-base`` for the
+reference-faithful weights.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE = {  # BASELINE.md, Medical Transcriptions (BioBERT, 20 rounds)
+    "server_iid_medical": {"final_acc": 0.68, "acc_10_workers": 0.672},
+    "serverless_noniid_medical": {"final_acc": 0.736},
+    "bcfl_async_pagerank_medical": {
+        "info_sync_s": 28.96, "info_async_s": 3.62},  # BC-FL, PageRank filter
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="small-bert")
+    ap.add_argument("--clients", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--hf", action="store_true")
+    ap.add_argument("--out", default="results")
+    ap.add_argument("--configs", nargs="*", default=None,
+                    help="subset of config names to run")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from bcfl_tpu.config import LedgerConfig, TopologyConfig
+    from bcfl_tpu.entrypoints.presets import get_preset
+    from bcfl_tpu.entrypoints.run import run
+    from bcfl_tpu.viz.plots import accuracy_curves
+
+    os.makedirs(args.out, exist_ok=True)
+
+    common = dict(model=args.model, num_clients=args.clients,
+                  num_rounds=args.rounds)
+
+    configs = {
+        "server_iid_medical": get_preset(
+            "server_iid_medical", hf=args.hf).replace(**common),
+        "serverless_noniid_medical": get_preset(
+            "serverless_noniid_medical", hf=args.hf).replace(**common),
+        # the BC-FL stack on the same data: hash-chained ledger payloads,
+        # PageRank-gated aggregation, buffered-async rounds
+        "bcfl_async_pagerank_medical": get_preset(
+            "serverless_noniid_medical", hf=args.hf).replace(
+                **common, sync="async",
+                async_buffer=max(args.clients // 2, 1),
+                topology=TopologyConfig(anomaly_filter="pagerank"),
+                ledger=LedgerConfig(enabled=True)),
+    }
+    if args.configs:
+        configs = {k: v for k, v in configs.items() if k in args.configs}
+
+    summary = {}
+    for name, cfg in configs.items():
+        print(f"\n===== {name} =====", flush=True)
+        t0 = time.time()
+        res = run(cfg, verbose=True)
+        wall = time.time() - t0
+        m = res.metrics
+        with open(os.path.join(args.out, f"{name}.json"), "w") as f:
+            f.write(m.to_json())
+        accs = m.global_accuracies
+        last = m.rounds[-1]
+        summary[name] = {
+            "model": args.model,
+            "hf_weights": bool(args.hf),
+            "clients": args.clients,
+            "rounds": args.rounds,
+            "final_acc": accs[-1] if accs else None,
+            "best_acc": max(accs) if accs else None,
+            "acc_curve": accs,
+            "model_size_gb": m.model_size_gb,
+            "wall_minutes": wall / 60.0,
+            "info_passing_sync_s": last.info_passing_sync_s,
+            "info_passing_async_s": last.info_passing_async_s,
+            "anomalies": last.anomalies,
+            "ledger": m.ledger,
+            "resources": m.resources,
+        }
+        print(f"[{name}] final acc "
+              f"{summary[name]['final_acc']}, wall {wall/60:.1f} min",
+              flush=True)
+
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    curves = {n: s["acc_curve"] for n, s in summary.items() if s["acc_curve"]}
+    if curves:
+        accuracy_curves(
+            curves, title="Medical Transcriptions: global accuracy vs round",
+            path=os.path.join(args.out, "medical_accuracy_curves.png"))
+    _write_results_md(args, summary)
+    print(f"\nwrote {args.out}/summary.json and RESULTS.md", flush=True)
+
+
+def _write_results_md(args, summary):
+    ref = REFERENCE
+    lines = [
+        "# RESULTS — real-data runs (Medical Transcriptions)",
+        "",
+        "Dataset: the reference's on-disk CSVs "
+        "(`/root/reference/Dataset/train_file_mt.csv` 12,021 rows / "
+        "`test_file_mt.csv` 3,003 rows, 40 medical specialties — the only "
+        "reference dataset whose data ships in the repo; SURVEY.md C20). "
+        "Loaded by `bcfl_tpu.data.datasets`, tokenized once, static-shape "
+        "batches.",
+        "",
+    ]
+    if not args.hf:
+        lines += [
+            "> **Weights caveat** — this host is zero-egress: the BioBERT "
+            "checkpoint and WordPiece tokenizer cannot be fetched, so these "
+            f"runs use fresh-initialized `{args.model}` with the hash "
+            "tokenizer. Absolute accuracy is therefore NOT comparable to the "
+            "reference's pretrained-BioBERT numbers; the comparison below is "
+            "directional (mode ordering, learning curves, info-passing "
+            "model). Re-run `python scripts/run_results.py --hf --model "
+            "biobert-base` on a connected host for the weight-faithful "
+            "experiment.",
+            "",
+        ]
+    lines += [
+        f"Configuration: {args.clients} clients x {args.rounds} rounds, "
+        "reference partition schedules (IID 500-random resampled/round for "
+        "server; Non-IID contiguous 500i/400 with fixed test slice for "
+        "serverless — SURVEY.md §2.1).",
+        "",
+        "| run | final acc | best acc | reference (BioBERT) final | model GB "
+        "| info sync s | info async s | wall min |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for name, s in summary.items():
+        r = ref.get(name, {})
+        rf = r.get("final_acc")
+        lines.append(
+            f"| {name} | "
+            f"{s['final_acc']:.3f} | {s['best_acc']:.3f} | "
+            f"{rf if rf is not None else '—'} | "
+            f"{s['model_size_gb']:.4f} | "
+            f"{s['info_passing_sync_s']:.2f} | "
+            f"{s['info_passing_async_s']:.2f} | "
+            f"{s['wall_minutes']:.1f} |")
+    lines += [
+        "",
+        "Reference numbers: BASELINE.md (Medical table; notebook cells "
+        "15/18/31 and the BC-FL cells 27-28).",
+        "",
+        "Figures: `results/medical_accuracy_curves.png` (+ per-run JSON in "
+        "`results/`).",
+        "",
+    ]
+    bc = summary.get("bcfl_async_pagerank_medical")
+    if bc:
+        lines += [
+            "## BC-FL extension (implemented, not just modeled)",
+            "",
+            "The reference's blockchain exists only as notebook markdown "
+            "(SURVEY.md L6). Here the run above actually executes it: "
+            "hash-chained per-(round, client) weight-digest ledger with "
+            "authentication gating aggregation, PageRank anomaly gating "
+            f"(anomalous nodes this run: {bc['anomalies']}), buffered-async "
+            "rounds, and ledger-payload info-passing accounting "
+            f"(sync {bc['info_passing_sync_s']:.2f}s / async "
+            f"{bc['info_passing_async_s']:.2f}s vs the reference's modeled "
+            "28.96s / 3.62s for the 0.043 GB payload class).",
+            "",
+        ]
+    with open("RESULTS.md", "w") as f:
+        f.write("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

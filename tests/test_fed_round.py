@@ -144,3 +144,34 @@ def test_all_masked_round_keeps_params():
     out, _ = progs.server_round(params, None, batches, w0, rngs)
     for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_server_rounds_scan_matches_sequential():
+    """The on-device multi-round program (R rounds in one jit, the bench /
+    static-config fast path) must produce exactly what R sequential
+    server_round calls produce."""
+    ds, cache, part, model, mesh, progs, params = _setup()
+    weights = mesh.shard_clients(jnp.ones((mesh.num_clients,)))
+
+    per_round = []
+    for rnd in range(2):
+        batches, n_ex, rngs = _round_inputs(cache, part, mesh, rnd)
+        per_round.append((batches, rngs))
+
+    # sequential reference
+    p_seq = params
+    seq_stats = []
+    for batches, rngs in per_round:
+        p_seq, stats = progs.server_round(p_seq, None, batches, weights, rngs)
+        seq_stats.append(np.asarray(stats))
+
+    # stacked [R, C, ...] inputs through the scanned program
+    stacked_b = jax.tree.map(lambda *xs: jnp.stack(xs),
+                             *[b for b, _ in per_round])
+    stacked_r = jnp.stack([r for _, r in per_round])
+    p_scan, stats = progs.server_rounds(params, None, stacked_b, weights,
+                                        stacked_r)
+    for a, b in zip(jax.tree.leaves(p_scan), jax.tree.leaves(p_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats), np.stack(seq_stats),
+                               rtol=2e-5, atol=1e-4)

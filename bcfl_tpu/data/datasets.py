@@ -63,9 +63,79 @@ def register_dataset(name: str):
 
 
 def load_dataset(name: str, **kw) -> TextDataset:
+    if name.startswith("csv:"):
+        return _load_csv_spec(name[4:], **kw)
     if name in _REGISTRY:
+        # registry datasets own their reference column mappings (SURVEY.md
+        # §2.1 matrix); config-level text_col/label_col only applies to
+        # csv:/hub datasets
+        kw.pop("text_col", None)
+        kw.pop("label_col", None)
         return _REGISTRY[name](**kw)
     return _load_hf(name, **kw)
+
+
+def _map_labels(raw, lut: Optional[Dict[str, int]] = None) -> tuple:
+    """Raw label column -> (int32 array, num_labels, lut). Integer labels
+    pass through (lut None); float columns are accepted only when exactly
+    integral (pandas upcasts int columns with a missing value to float —
+    silently string-sorting "10.0" before "2.0" would corrupt every label);
+    string labels map by sorted unique value (deterministic). Pass ``lut`` to
+    reuse an existing mapping (e.g. augmentation files must share the base
+    file's classes)."""
+    arr = np.asarray(raw)
+    if arr.dtype.kind in "iu":
+        labels = arr.astype(np.int32)
+        return labels, int(labels.max()) + 1, None
+    if arr.dtype.kind == "f":
+        if np.isnan(arr).any():
+            raise ValueError("label column contains NaN/missing values")
+        if not (arr == np.round(arr)).all():
+            raise ValueError(
+                "label column is float with non-integral values; map your "
+                "labels to ints or strings explicitly")
+        labels = arr.astype(np.int32)
+        return labels, int(labels.max()) + 1, None
+    if arr.dtype.kind not in "OUS":
+        raise ValueError(f"unsupported label dtype {arr.dtype}")
+    if lut is None:
+        values = sorted({str(v) for v in arr})
+        lut = {v: i for i, v in enumerate(values)}
+    try:
+        mapped = [lut[str(v)] for v in arr]
+    except KeyError as e:
+        raise ValueError(f"label {e.args[0]!r} not in mapping {sorted(lut)}")
+    return np.asarray(mapped, np.int32), len(lut), lut
+
+
+def _holdout_split(texts, labels, test_frac: float, seed: int):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(texts))
+    n_test = max(int(len(texts) * test_frac), 1)
+    te, tr = idx[:n_test], idx[n_test:]
+    return ([texts[i] for i in tr], labels[tr],
+            [texts[i] for i in te], labels[te])
+
+
+def _load_csv_spec(spec: str, text_col: str = "text", label_col: str = "label",
+                   num_labels: int = 0, test_frac: float = 0.2,
+                   seed: int = 42, **_) -> TextDataset:
+    """Generic local-CSV dataset: ``dataset="csv:<train.csv>"`` (deterministic
+    holdout split) or ``csv:<train.csv>::<test.csv>``; column names come from
+    the config's ``text_col`` / ``label_col``. This is the offline answer to
+    the reference's hub datasets — any corpus a user has on disk runs through
+    the same pipeline (string labels map to ints by sorted unique value)."""
+    parts = spec.split("::")
+    tr_t, tr_raw = _read_raw_csv(parts[0], text_col, label_col)
+    if len(parts) > 1:
+        te_t, te_raw = _read_raw_csv(parts[1], text_col, label_col)
+        labels, n, _ = _map_labels(list(tr_raw) + list(te_raw))
+        tr_y, te_y = labels[:len(tr_t)], labels[len(tr_t):]
+    else:
+        labels, n, _ = _map_labels(tr_raw)
+        tr_t, tr_y, te_t, te_y = _holdout_split(tr_t, labels, test_frac, seed)
+    name = "csv:" + os.path.basename(parts[0])
+    return TextDataset(name, tr_t, tr_y, te_t, te_y, max(n, num_labels))
 
 
 # --------------------------------------------------------------------------
@@ -110,6 +180,7 @@ def _synthetic(
     doc_len: int = 32,
     seed: int = 42,
     name: str = "synthetic",
+    **_,
 ) -> TextDataset:
     rng = np.random.default_rng(seed)
     tr_t, tr_y = _synthetic_split(rng, n_train, num_labels, doc_len)
@@ -122,13 +193,15 @@ def _synthetic(
 # --------------------------------------------------------------------------
 
 
-def _read_csv(path: str, text_col: str, label_col: str):
+def _read_raw_csv(path: str, text_col: str, label_col: str):
     import pandas as pd
 
     df = pd.read_csv(path)
-    texts = df[text_col].astype(str).tolist()
-    labels = df[label_col].astype(np.int32).to_numpy()
-    return texts, labels
+    for col in (text_col, label_col):
+        if col not in df.columns:
+            raise ValueError(
+                f"{path}: column {col!r} not found; have {df.columns.tolist()}")
+    return df[text_col].astype(str).tolist(), df[label_col].tolist()
 
 
 @register_dataset("medical_transcriptions")
@@ -145,8 +218,10 @@ def _medical(
     te = os.path.join(data_dir, "test_file_mt.csv")
     if not (os.path.exists(tr) and os.path.exists(te)):
         return _synthetic(num_labels=num_labels, name="medical_transcriptions")
-    tr_t, tr_y = _read_csv(tr, "description", "medical_specialty")
-    te_t, te_y = _read_csv(te, "description", "medical_specialty")
+    tr_t, tr_raw = _read_raw_csv(tr, "description", "medical_specialty")
+    te_t, te_raw = _read_raw_csv(te, "description", "medical_specialty")
+    tr_y, _, _ = _map_labels(tr_raw)
+    te_y, _, _ = _map_labels(te_raw)
     n = int(max(tr_y.max(), te_y.max())) + 1
     return TextDataset("medical_transcriptions", tr_t, tr_y, te_t, te_y, max(n, num_labels))
 
@@ -180,12 +255,72 @@ def _covid(num_labels: int = 41, **kw) -> TextDataset:
     )
 
 
+@register_dataset("self_driving_sentiment")
+def _self_driving(
+    data_dir: str = REFERENCE_DATASET_DIR,
+    num_labels: int = 3,
+    augmented: Optional[str] = None,  # None | "ctgan" | "copula" | "shuffle"
+    test_frac: float = 0.2,
+    seed: int = 42,
+    **_,
+) -> TextDataset:
+    """Reference: ``Dataset/sentiment_analysis_self_driving_vehicles.csv``
+    (500 rows, ``Text`` -> ``Sentiment`` in {Negative, Neutral, Positive})
+    plus the synthetic-augmentation variants under ``Augmeted_datasets/``
+    (CTGAN / GaussianCopula / random-shuffle — SURVEY.md C20). ``augmented``
+    APPENDS the chosen augmentation file to the train split (the augmentation
+    use-case); the holdout test split always comes from the real rows."""
+    files = {
+        "ctgan": "Augmeted_datasets/CTGAN_self_driving_vehicles.csv",
+        "copula": "Augmeted_datasets/output_Gaussiancopula_self_driving.csv",
+        "shuffle": "Augmeted_datasets/output_file_path_random_counts.csv",
+    }
+    if augmented is not None and augmented not in files:
+        raise ValueError(
+            f"unknown augmentation {augmented!r}; have {sorted(files)}")
+    variant = f"+{augmented}" if augmented else ""
+    base = os.path.join(data_dir, "sentiment_analysis_self_driving_vehicles.csv")
+    if not os.path.exists(base):
+        warnings.warn(
+            f"{base} not found; using a deterministic synthetic stand-in",
+            stacklevel=2)
+        return _synthetic(
+            num_labels=num_labels, seed=seed,
+            name=f"self_driving_sentiment{variant}:synthetic-standin")
+    texts, raw = _read_raw_csv(base, "Text", "Sentiment")
+    labels, n, lut = _map_labels(raw)
+    tr_t, tr_y, te_t, te_y = _holdout_split(texts, labels, test_frac, seed)
+    if augmented is not None:
+        aug_t, aug_raw = _read_raw_csv(
+            os.path.join(data_dir, files[augmented]), "Text", "Sentiment")
+        aug_y, _n, _ = _map_labels(aug_raw, lut)  # base file's class mapping
+        tr_t = tr_t + aug_t
+        tr_y = np.concatenate([tr_y, aug_y]).astype(np.int32)
+    return TextDataset(f"self_driving_sentiment{variant}",
+                       tr_t, tr_y, te_t, te_y, max(n, num_labels))
+
+
 def _load_hf(name: str, text_col: str = "text", label_col: str = "label",
              num_labels: int = 2, alias: Optional[str] = None, seed: int = 42) -> TextDataset:
     import datasets as hf_datasets
 
     ds = hf_datasets.load_dataset(name)
     train, test = ds["train"], ds.get("test", ds["train"])
+
+    # the config defaults are reference-flavored (label_col="labels"); hub
+    # datasets mostly use "label" — resolve against what actually exists so
+    # a bare hub name works without per-dataset column config
+    def resolve(col, alts):
+        if col in train.column_names:
+            return col
+        for a in alts:
+            if a in train.column_names:
+                return a
+        raise ValueError(
+            f"{name}: column {col!r} not found; have {train.column_names}")
+
+    text_col = resolve(text_col, ("text", "sentence"))
+    label_col = resolve(label_col, ("label", "labels"))
     tr_y = np.asarray(train[label_col], dtype=np.int32)
     te_y = np.asarray(test[label_col], dtype=np.int32)
     n = int(max(tr_y.max(), te_y.max())) + 1

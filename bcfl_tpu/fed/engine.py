@@ -96,7 +96,9 @@ class FedEngine:
         self.root_key = jax.random.key(cfg.seed)
 
         # --- data (tokenize once; SURVEY.md §3.2 fixes the 200x re-tokenize) ---
-        self.dataset = load_dataset(cfg.dataset, num_labels=cfg.num_labels)
+        self.dataset = load_dataset(
+            cfg.dataset, num_labels=cfg.num_labels,
+            text_col=cfg.text_col, label_col=cfg.label_col)
         self.tokenizer = get_tokenizer(cfg.tokenizer, cfg.vocab_size)
         self.cache = TokenCache.build(self.dataset, self.tokenizer, cfg.seq_len)
         self.num_labels = max(cfg.num_labels, self.cache.num_labels)

@@ -1,5 +1,6 @@
 import jax
 import numpy as np
+import pytest
 
 from bcfl_tpu.config import PartitionConfig
 from bcfl_tpu.data import (
@@ -62,3 +63,73 @@ def test_central_eval_batches():
     b = central_eval_batches(cache, batch_size=32)
     assert b["ids"].shape == (3, 32, 16)
     assert b["example_mask"].sum() == 70
+
+
+def test_generic_csv_dataset(tmp_path):
+    """dataset='csv:<path>' loads any local corpus through the pipeline:
+    config-driven columns, string labels mapped deterministically, holdout
+    split when no test file is given."""
+    import pandas as pd
+
+    from bcfl_tpu.data.datasets import load_dataset
+
+    p = tmp_path / "corpus.csv"
+    pd.DataFrame({
+        "body": [f"doc {i} " + ("good" if i % 2 else "bad") for i in range(50)],
+        "verdict": ["pos" if i % 2 else "neg" for i in range(50)],
+    }).to_csv(p, index=False)
+
+    ds = load_dataset(f"csv:{p}", text_col="body", label_col="verdict")
+    assert ds.num_labels == 2
+    assert ds.n_train + ds.n_test == 50 and ds.n_test >= 10
+    # deterministic: same split + mapping on reload
+    ds2 = load_dataset(f"csv:{p}", text_col="body", label_col="verdict")
+    assert ds.train_texts == ds2.train_texts
+    np.testing.assert_array_equal(ds.train_labels, ds2.train_labels)
+
+    # explicit train::test pair
+    q = tmp_path / "test.csv"
+    pd.DataFrame({"body": ["x good", "y bad"], "verdict": ["pos", "neg"]}
+                 ).to_csv(q, index=False)
+    ds3 = load_dataset(f"csv:{p}::{q}", text_col="body", label_col="verdict")
+    assert ds3.n_test == 2 and ds3.n_train == 50
+
+    # missing column errors loudly
+    with pytest.raises(ValueError, match="not found"):
+        load_dataset(f"csv:{p}", text_col="nope", label_col="verdict")
+
+
+def test_self_driving_sentiment_real_csv():
+    """The reference's on-disk self-driving sentiment CSV (500 rows,
+    Text -> Sentiment) and its augmentation variants (SURVEY.md C20)."""
+    import os
+
+    from bcfl_tpu.data.datasets import REFERENCE_DATASET_DIR, load_dataset
+
+    if not os.path.exists(os.path.join(
+            REFERENCE_DATASET_DIR,
+            "sentiment_analysis_self_driving_vehicles.csv")):
+        pytest.skip("reference dataset dir not mounted")
+    ds = load_dataset("self_driving_sentiment")
+    assert ds.num_labels == 3
+    assert ds.n_train + ds.n_test == 500
+    aug = load_dataset("self_driving_sentiment", augmented="ctgan")
+    assert aug.n_train == ds.n_train + 500  # augmentation appends to train
+    assert aug.n_test == ds.n_test  # test stays the real holdout
+    assert set(np.unique(aug.train_labels)) <= {0, 1, 2}
+    with pytest.raises(ValueError, match="unknown augmentation"):
+        load_dataset("self_driving_sentiment", augmented="gan2")
+
+
+def test_map_labels_float_column_guard():
+    """pandas upcasts an int label column with a missing value to float;
+    lexicographic string-mapping of '10.0' vs '2.0' would silently corrupt
+    labels, so floats must either be exactly integral or error."""
+    from bcfl_tpu.data.datasets import _map_labels
+
+    y, n, lut = _map_labels(np.array([0.0, 2.0, 10.0]))
+    assert y.tolist() == [0, 2, 10] and n == 11 and lut is None
+    with pytest.raises(ValueError, match="NaN"):
+        _map_labels(np.array([0.0, np.nan]))
+    with pytest.raises(ValueError, match="non-integral"):
+        _map_labels(np.array([0.5, 1.0]))

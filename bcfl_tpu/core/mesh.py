@@ -61,6 +61,12 @@ class ClientMesh:
         """Device-put a pytree whose leaves all have leading dim num_clients."""
         return jax.device_put(tree, self.client_sharding())
 
+    def shard_round_clients(self, tree):
+        """Device-put leaves shaped [R, num_clients, ...] (round-leading,
+        client dim sharded) — the multi-round program's input layout."""
+        return jax.device_put(
+            tree, NamedSharding(self.mesh, P(None, CLIENT_AXIS)))
+
     def replicate(self, tree):
         return jax.device_put(tree, self.replicated())
 

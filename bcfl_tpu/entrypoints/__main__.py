@@ -35,6 +35,9 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=None)
     ap.add_argument("--lora-rank", type=int, default=None)
     ap.add_argument("--max-local-batches", type=int, default=None)
+    ap.add_argument("--rounds-per-dispatch", type=int, default=None,
+                    help="fuse up to N federated rounds into one XLA dispatch "
+                         "(sync server mode without ledger/filter only)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--faithful", action="store_true",
                     help="reference-exact sequential serverless semantics")
@@ -65,6 +68,7 @@ def main(argv=None):
         "seq_len": "seq_len", "batch_size": "batch_size",
         "lr": "learning_rate", "lora_rank": "lora_rank",
         "max_local_batches": "max_local_batches", "seed": "seed",
+        "rounds_per_dispatch": "rounds_per_dispatch",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
     }
     overrides = {}

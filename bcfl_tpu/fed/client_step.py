@@ -113,7 +113,8 @@ class FedPrograms:
 
     mesh: ClientMesh
     server_round: Callable  # (global_t, frozen, batches, weights, rngs) -> (global_t, metrics)
-    server_rounds: Callable  # R rounds in one program; batches/rngs leaves [R, C, ...]
+    server_rounds: Callable  # R rounds in one program; batches/weights/rngs leaves [R, C, ...]
+    server_rounds_static: Callable  # same, ONE batch tree [C, ...] reused every round
     gossip_round: Callable  # (client_t, frozen, batches, mask, rngs) -> (client_t, metrics)
     eval_clients: Callable  # (client_t, frozen, batches) -> per-client [C, 3] stats
     eval_clients_global: Callable  # (global_t, frozen, batches) -> per-client [C, 3] stats
@@ -223,18 +224,38 @@ def build_programs(
     # rounds); this is the bench/static-config path.
     def server_rounds_shard(global_t, frozen, batches, weights, rngs):
         def one_round(t, xs):
-            b, r = xs
-            return server_shard(t, frozen, b, weights, r)
+            b, w, r = xs
+            return server_shard(t, frozen, b, w, r)
 
-        # batches/rngs leaves are [R, Cl, ...] (round-leading, client dim
-        # sharded); scan consumes the leading round axis
-        return lax.scan(one_round, global_t, (batches, rngs))
+        # batches/weights/rngs leaves are [R, Cl, ...] (round-leading, client
+        # dim sharded); scan consumes the leading round axis
+        return lax.scan(one_round, global_t, (batches, weights, rngs))
 
     rshard = P(None, "clients")
     server_rounds = jax.jit(
         shard_map(
             server_rounds_shard, mesh=jmesh,
-            in_specs=(repl, repl, rshard, shard, rshard),
+            in_specs=(repl, repl, rshard, rshard, rshard),
+            out_specs=(repl, rshard),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    # static-partition variant: every round reuses ONE batch tree [Cl, ...]
+    # (round-static partitions would otherwise stack R identical copies of
+    # the batches on device — an R-fold HBM blowup for no information)
+    def server_rounds_static_shard(global_t, frozen, batches, weights, rngs):
+        def one_round(t, xs):
+            w, r = xs
+            return server_shard(t, frozen, batches, w, r)
+
+        return lax.scan(one_round, global_t, (weights, rngs))
+
+    server_rounds_static = jax.jit(
+        shard_map(
+            server_rounds_static_shard, mesh=jmesh,
+            in_specs=(repl, repl, shard, rshard, rshard),
             out_specs=(repl, rshard),
             check_vma=False,
         ),
@@ -343,6 +364,7 @@ def build_programs(
         mesh=mesh,
         server_round=server_round,
         server_rounds=server_rounds,
+        server_rounds_static=server_rounds_static,
         gossip_round=gossip_round,
         eval_clients=eval_clients,
         eval_clients_global=eval_clients_global,

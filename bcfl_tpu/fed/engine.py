@@ -264,7 +264,21 @@ class FedEngine:
 
         async_state = self._init_async_state() if cfg.sync == "async" else None
 
-        for rnd in range(start_round, cfg.num_rounds):
+        rnd = start_round
+        while rnd < cfg.num_rounds:
+            chunk = self._chunk_rounds(rnd)
+            if chunk > 1:
+                t0 = time.time()
+                with clock.phase("round_program"):
+                    trainable, recs = self._server_chunk(rnd, trainable, chunk)
+                self._annotate_chunk(recs, time.time() - t0)
+                last_rnd = rnd + chunk - 1
+                self._maybe_eval(last_rnd, recs[-1], trainable, stacked, clock)
+                metrics.rounds.extend(recs)
+                self._maybe_checkpoint(last_rnd, trainable, stacked)
+                rnd += chunk
+                continue
+
             t0 = time.time()
             with clock.phase("control_plane"):
                 gate = self._participation(rnd)
@@ -293,37 +307,10 @@ class FedEngine:
             rec.info_passing_async_s = async_t
             rec.wall_s = time.time() - t0
 
-            if cfg.eval_every and (rnd + 1) % cfg.eval_every == 0:
-                with clock.phase("eval"):
-                    loss, acc = self._global_eval(trainable)
-                    rec.global_loss, rec.global_acc = loss, acc
-                    # reference-style per-client local accuracy on each
-                    # client's LOCAL TEST split (serverless_NonIID_IMDB.py
-                    # :291-292; Flower client.evaluate
-                    # server_IID_IMDB.py:176-179)
-                    tb = self._test_batches(rnd)
-                    if stacked is not None:
-                        s = self.progs.eval_clients(stacked, self.frozen, tb)
-                    else:
-                        s = self.progs.eval_clients_global(
-                            trainable, self.frozen, tb)
-                    s = np.asarray(s)
-                    rec.local_acc = (s[:, 1] / np.maximum(s[:, 2], 1)).tolist()
+            self._maybe_eval(rnd, rec, trainable, stacked, clock)
             metrics.rounds.append(rec)
-
-            if cfg.checkpoint_dir and cfg.checkpoint_every and \
-                    (rnd + 1) % cfg.checkpoint_every == 0:
-                state = {
-                    "trainable": jax.device_get(trainable),
-                    "stacked": jax.device_get(stacked) if stacked is not None else None,
-                    # the RNG stream is derived deterministically from the
-                    # seed + round; storing the seed lets resume verify it
-                    "seed": np.int64(cfg.seed),
-                }
-                save_checkpoint(
-                    cfg.checkpoint_dir, rnd, state,
-                    self.ledger.to_json() if self.ledger else None,
-                )
+            self._maybe_checkpoint(rnd, trainable, stacked)
+            rnd += 1
 
         params = _merge(trainable, self.frozen)
         metrics.model_size_gb = model_size_gb(params)
@@ -334,6 +321,109 @@ class FedEngine:
             metrics.ledger["chain_ok"] = float(self.ledger.verify_chain() == -1)
         return RunResult(metrics=metrics, trainable=trainable, params=params,
                          ledger=self.ledger)
+
+    # ------------------------------------------------- eval/checkpoint cadence
+
+    def _maybe_eval(self, rnd: int, rec: RoundRecord, trainable, stacked,
+                    clock) -> None:
+        cfg = self.cfg
+        if not (cfg.eval_every and (rnd + 1) % cfg.eval_every == 0):
+            return
+        with clock.phase("eval"):
+            loss, acc = self._global_eval(trainable)
+            rec.global_loss, rec.global_acc = loss, acc
+            # reference-style per-client local accuracy on each client's
+            # LOCAL TEST split (serverless_NonIID_IMDB.py:291-292; Flower
+            # client.evaluate server_IID_IMDB.py:176-179)
+            tb = self._test_batches(rnd)
+            if stacked is not None:
+                s = self.progs.eval_clients(stacked, self.frozen, tb)
+            else:
+                s = self.progs.eval_clients_global(trainable, self.frozen, tb)
+            s = np.asarray(s)
+            rec.local_acc = (s[:, 1] / np.maximum(s[:, 2], 1)).tolist()
+
+    def _maybe_checkpoint(self, rnd: int, trainable, stacked) -> None:
+        cfg = self.cfg
+        if not (cfg.checkpoint_dir and cfg.checkpoint_every
+                and (rnd + 1) % cfg.checkpoint_every == 0):
+            return
+        state = {
+            "trainable": jax.device_get(trainable),
+            "stacked": jax.device_get(stacked) if stacked is not None else None,
+            # the RNG stream is derived deterministically from the seed +
+            # round; storing the seed lets resume verify it
+            "seed": np.int64(cfg.seed),
+        }
+        save_checkpoint(
+            cfg.checkpoint_dir, rnd, state,
+            self.ledger.to_json() if self.ledger else None,
+        )
+
+    # -------------------------------------------------- multi-round fast path
+
+    def _chunk_rounds(self, rnd: int) -> int:
+        """How many rounds starting at ``rnd`` can fuse into one dispatch.
+
+        Eligible only when the host has nothing to do between rounds: sync
+        server FedAvg, no ledger commit/verify, no anomaly filter (the mask
+        is all-ones), no tamper hook. Chunks never cross an eval or
+        checkpoint boundary, so the observable cadence is identical to the
+        per-round path."""
+        cfg = self.cfg
+        k = cfg.rounds_per_dispatch
+        if (k <= 1 or cfg.mode != "server" or cfg.sync != "sync"
+                or self.ledger is not None or self.tamper_hook is not None
+                or cfg.topology.anomaly_filter is not None):
+            return 1
+        k = min(k, cfg.num_rounds - rnd)
+        if cfg.eval_every:
+            k = min(k, cfg.eval_every - rnd % cfg.eval_every)
+        if cfg.checkpoint_dir and cfg.checkpoint_every:
+            k = min(k, cfg.checkpoint_every - rnd % cfg.checkpoint_every)
+        return max(k, 1)
+
+    def _server_chunk(self, rnd: int, trainable, k: int):
+        """Run rounds [rnd, rnd+k) in ONE XLA dispatch via server_rounds."""
+        cfg = self.cfg
+        ones = np.ones((cfg.num_clients,), np.float32)
+        batch_list, weight_list, rng_list = [], [], []
+        for r in range(rnd, rnd + k):
+            b, n_ex = self._round_batches(r)
+            batch_list.append(b)
+            weight_list.append(np.asarray(
+                ones * (n_ex if cfg.weighted_agg else 1.0), np.float32))
+            rng_list.append(self._rngs(r))
+        rweights = self.mesh.shard_round_clients(
+            jnp.asarray(np.stack(weight_list)))
+        rrngs = self.mesh.shard_round_clients(
+            jnp.stack([jnp.asarray(r) for r in rng_list]))
+        if all(b is batch_list[0] for b in batch_list):
+            # round-static partition (cache hit): ONE batch tree on device
+            # instead of k identical stacked copies
+            trainable, stats = self.progs.server_rounds_static(
+                trainable, self.frozen, batch_list[0], rweights, rrngs)
+        else:
+            rbatches = self.mesh.shard_round_clients(
+                jax.tree.map(lambda *xs: jnp.stack(xs), *batch_list))
+            trainable, stats = self.progs.server_rounds(
+                trainable, self.frozen, rbatches, rweights, rrngs)
+        stats = np.asarray(stats)  # [k, C, 3]
+        return trainable, [self._stats_to_rec(rnd + i, stats[i])
+                           for i in range(k)]
+
+    def _annotate_chunk(self, recs, wall: float) -> None:
+        """Participation/info-passing fields for fused rounds (all-ones mask
+        by construction; wall time split evenly across the chunk)."""
+        C = self.cfg.num_clients
+        sync_t, async_t = self.graph.info_passing_time(
+            self._payload_gb(), source=self.info_source, anomalies=())
+        for rec in recs:
+            rec.mask = [1.0] * C
+            rec.anomalies = []
+            rec.info_passing_sync_s = sync_t
+            rec.info_passing_async_s = async_t
+            rec.wall_s = wall / max(len(recs), 1)
 
     # ----------------------------------------------------------- round bodies
 

@@ -130,10 +130,12 @@ def main():
         # throughput bench, not a learning run)
         rbatches = jax.tree.map(
             lambda x: jnp.broadcast_to(x[None], (ROUNDS,) + x.shape), batches)
+        rweights = jnp.broadcast_to(weights[None], (ROUNDS,) + weights.shape)
         rrngs = jnp.broadcast_to(rngs[None], (ROUNDS,) + rngs.shape)
 
         watchdog.stage("compile")
-        params, stats = progs.server_rounds(params, None, rbatches, weights, rrngs)
+        params, stats = progs.server_rounds(
+            params, None, rbatches, rweights, rrngs)
         jax.block_until_ready(params)
 
         watchdog.stage("measure")
@@ -143,7 +145,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(ITERS):
             params, stats = progs.server_rounds(
-                params, None, rbatches, weights, rrngs)
+                params, None, rbatches, rweights, rrngs)
         jax.block_until_ready(params)
         dt = time.perf_counter() - t0
         if trace_dir:

@@ -227,3 +227,60 @@ def test_async_merge_scale_shrinks_stale_step():
     a = np.array([0.5 * 10.0, 1.0 * 20.0, 0.0], np.float32)  # alpha * n_ex
     assert eng_w._async_merge_scale(a, [0, 1], np.array([10.0, 20.0, 5.0])) \
         == pytest.approx((5.0 + 20.0) / 30.0)
+
+
+def test_rounds_per_dispatch_matches_per_round_path():
+    """Fusing rounds into one dispatch (rounds_per_dispatch) must reproduce
+    the per-round path bit-for-bit in results and keep the eval cadence."""
+    import jax
+
+    base = _cfg(mode="server", num_rounds=4, eval_every=2)
+    r1 = FedEngine(base).run()
+    rk = FedEngine(base.replace(rounds_per_dispatch=4)).run()
+
+    assert len(rk.metrics.rounds) == 4
+    # eval happened exactly at rounds 1 and 3 on both paths
+    evald = [r.round for r in rk.metrics.rounds if r.global_acc is not None]
+    assert evald == [1, 3]
+    np.testing.assert_allclose(
+        rk.metrics.global_accuracies, r1.metrics.global_accuracies, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(jax.device_get(rk.trainable)),
+                    jax.tree.leaves(jax.device_get(r1.trainable))):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    # per-round train stats line up too
+    for ra, rb in zip(rk.metrics.rounds, r1.metrics.rounds):
+        assert ra.round == rb.round
+        np.testing.assert_allclose(ra.train_loss, rb.train_loss, rtol=1e-4)
+
+
+def test_rounds_per_dispatch_ineligible_configs_fall_back():
+    """Ledger / anomaly-filter / serverless configs must silently use the
+    per-round path (the host is needed between rounds)."""
+    cfg = _cfg(mode="serverless", num_rounds=2, rounds_per_dispatch=8)
+    eng = FedEngine(cfg)
+    assert eng._chunk_rounds(0) == 1
+    cfg2 = _cfg(mode="server", num_rounds=2, rounds_per_dispatch=8,
+                ledger=LedgerConfig(enabled=True))
+    assert FedEngine(cfg2)._chunk_rounds(0) == 1
+    cfg3 = _cfg(mode="server", num_clients=10, num_rounds=2,
+                rounds_per_dispatch=8,
+                topology=TopologyConfig(anomaly_filter="pagerank"))
+    assert FedEngine(cfg3)._chunk_rounds(0) == 1
+    # eligible config: bounded by eval boundary and remaining rounds
+    cfg4 = _cfg(mode="server", num_rounds=3, rounds_per_dispatch=8,
+                eval_every=2)
+    eng4 = FedEngine(cfg4)
+    assert eng4._chunk_rounds(0) == 2
+    assert eng4._chunk_rounds(2) == 1
+
+
+def test_rounds_per_dispatch_resampled_partition():
+    """Per-round resampling (batches differ each round) goes through the
+    stacked-batches variant and still matches the per-round path."""
+    base = _cfg(mode="server", num_rounds=2, eval_every=2,
+                partition=PartitionConfig(kind="iid", iid_samples=64,
+                                          resample_each_round=True))
+    r1 = FedEngine(base).run()
+    rk = FedEngine(base.replace(rounds_per_dispatch=2)).run()
+    np.testing.assert_allclose(
+        rk.metrics.global_accuracies, r1.metrics.global_accuracies, atol=1e-6)

@@ -168,8 +168,9 @@ def test_server_rounds_scan_matches_sequential():
     # stacked [R, C, ...] inputs through the scanned program
     stacked_b = jax.tree.map(lambda *xs: jnp.stack(xs),
                              *[b for b, _ in per_round])
+    stacked_w = jnp.stack([weights, weights])
     stacked_r = jnp.stack([r for _, r in per_round])
-    p_scan, stats = progs.server_rounds(params, None, stacked_b, weights,
+    p_scan, stats = progs.server_rounds(params, None, stacked_b, stacked_w,
                                         stacked_r)
     for a, b in zip(jax.tree.leaves(p_scan), jax.tree.leaves(p_seq)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

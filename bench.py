@@ -21,7 +21,9 @@ tunnel can hang indefinitely at init — r01 lost its perf evidence to an
 unguarded failure, and the r03 session saw multi-hour init hangs).
 
 Env knobs: BCFL_BENCH_TRACE=<dir> captures a jax.profiler trace of the timed
-block; BCFL_BENCH_ROUNDS/STEPS/ITERS override the shape.
+block; BCFL_BENCH_ROUNDS/STEPS/ITERS override the shape;
+BCFL_BENCH_PLATFORM=<platform> redirects the backend via jax.config (the
+JAX_PLATFORMS env var is overridden by site hooks on some hosts).
 """
 
 from __future__ import annotations
@@ -98,6 +100,12 @@ def main():
 
     try:
         import jax
+
+        # site hooks can pin JAX_PLATFORMS at interpreter start, so an env
+        # var alone cannot redirect the bench to another backend
+        if os.environ.get("BCFL_BENCH_PLATFORM"):
+            jax.config.update("jax_platforms",
+                              os.environ["BCFL_BENCH_PLATFORM"])
         import jax.numpy as jnp
 
         from bcfl_tpu.core.mesh import client_mesh

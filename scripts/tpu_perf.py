@@ -1,0 +1,199 @@
+"""TPU performance evidence harness -> PERF.md.
+
+Run on a host with the real chip (falls back to the CPU mesh for plumbing
+checks with --platform cpu). Produces:
+
+1. the headline fed-finetune bench at several dispatch shapes (shows the
+   dispatch-amortization curve that motivated ``server_rounds``),
+2. flash-attention kernel timings — Pallas forward+backward vs the XLA
+   blockwise path vs dense attention — across sequence lengths,
+3. a ``jax.profiler`` trace of the headline config (``--trace-dir``),
+4. PERF.md summarizing all of it with the MFU derivation.
+
+Usage: python scripts/tpu_perf.py [--platform cpu] [--trace-dir perf_trace]
+       [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _time_call(fn, *args, iters=3, warmup=1):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_sweep(trace_dir=None, quick=False):
+    """Headline bench at several (rounds, steps) dispatch shapes."""
+    shapes = [(1, 4), (4, 8)] if quick else [(1, 4), (1, 8), (4, 8), (8, 8)]
+    rows = []
+    for rounds, steps in shapes:
+        env = dict(os.environ,
+                   BCFL_BENCH_ROUNDS=str(rounds), BCFL_BENCH_STEPS=str(steps),
+                   BCFL_BENCH_ITERS="2")
+        if trace_dir and (rounds, steps) == shapes[-1]:
+            env["BCFL_BENCH_TRACE"] = trace_dir
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                              "bench.py")],
+                env=env, capture_output=True, text=True, timeout=5400)
+            line = [l for l in out.stdout.splitlines() if l.startswith("{")]
+            row = (json.loads(line[-1]) if line
+                   else {"error": out.stderr[-300:]})
+        except subprocess.TimeoutExpired:
+            # keep the completed rows — evidence must survive one bad shape
+            row = {"error": "bench subprocess exceeded 5400s"}
+        row["rounds"], row["steps"] = rounds, steps
+        rows.append(row)
+        print(f"bench rounds={rounds} steps={steps}: {row}", flush=True)
+    return rows
+
+
+def attention_sweep(quick=False):
+    """Pallas fwd/bwd vs XLA blockwise vs dense, by sequence length."""
+    import jax
+    import jax.numpy as jnp
+
+    from bcfl_tpu.ops.attention import dot_product_attention
+    from bcfl_tpu.ops.flash import flash_attention_xla
+    from bcfl_tpu.ops.pallas_flash import flash_attention as flash_pl
+
+    B, H, D = (1, 2, 32) if quick else (2, 12, 64)
+    seqs = [256, 512] if quick else [512, 1024, 2048, 4096]
+    rows = []
+    for S in seqs:
+        q = jax.random.normal(jax.random.key(0), (B, H, S, D), jnp.bfloat16)
+
+        def pl_fwd(q):
+            return flash_pl(q, q, q, None, True, 256, 256)
+
+        def xla_fwd(q):
+            return flash_attention_xla(q, q, q, None, block_size=256,
+                                       causal=True)
+
+        def pl_bwd(q):
+            return jax.grad(lambda x: pl_fwd(x).astype(jnp.float32).sum())(q)
+
+        def xla_bwd(q):
+            return jax.grad(lambda x: xla_fwd(x).astype(jnp.float32).sum())(q)
+
+        row = {"seq": S,
+               "pallas_fwd_ms": _time_call(jax.jit(pl_fwd), q) * 1e3,
+               "xla_fwd_ms": _time_call(jax.jit(xla_fwd), q) * 1e3,
+               "pallas_bwd_ms": _time_call(jax.jit(pl_bwd), q) * 1e3,
+               "xla_bwd_ms": _time_call(jax.jit(xla_bwd), q) * 1e3}
+        if S <= 2048:  # dense is O(S^2) memory
+            from bcfl_tpu.models.llama import causal_bias
+
+            bias = causal_bias(jnp.ones((B, S), jnp.int32))
+            row["dense_fwd_ms"] = _time_call(
+                jax.jit(lambda q: dot_product_attention(q, q, q, bias)), q) * 1e3
+        rows.append({k: (round(v, 2) if isinstance(v, float) else v)
+                     for k, v in row.items()})
+        print(f"attention seq={S}: {rows[-1]}", flush=True)
+    return f"B={B}, H={H}, D={D}", rows
+
+
+def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir):
+    lines = [
+        "# PERF — measured performance evidence",
+        "",
+        f"Device: **{device}**. Metric derivations:",
+        "",
+        "- throughput: samples/sec/chip over the timed multi-round dispatch "
+        "(`bench.py`; baseline 8.33 samples/s = the reference's serverless "
+        "IMDB 10-worker run, BASELINE.md).",
+        "- MFU: `6 * params * tokens / dt / peak_bf16` (fwd 2PD + bwd 4PD; "
+        "v5e peak 197 TFLOP/s).",
+        "",
+        "## Fed fine-tune throughput vs dispatch shape",
+        "",
+        "Each dispatch runs `rounds x steps` training steps on-device "
+        "(`server_rounds`). More work per dispatch amortizes the host "
+        "round-trip — on the tunnelled chip the per-dispatch overhead was "
+        "~8 s in round 2 (the replicated 0.44 GB BERT-base param tree "
+        "re-crossing the link), which capped the old 4-step bench at 14.69 "
+        "samples/s/chip (~0.6% MFU).",
+        "",
+        "| rounds/dispatch | steps/round | samples/s/chip | vs baseline | MFU % |",
+        "|---|---|---|---|---|",
+    ]
+    for r in bench_rows:
+        if "error" in r:
+            err = str(r["error"]).replace("\n", " ").replace("|", "\\|")
+            lines.append(
+                f"| {r.get('rounds', '—')} | {r.get('steps', '—')} | "
+                f"ERROR: {err} | | |")
+            continue
+        lines.append(
+            f"| {r['rounds']} | {r['steps']} | {r['value']} | "
+            f"{r['vs_baseline']} | {r.get('mfu_pct', '—')} |")
+    lines += [
+        "",
+        f"## Flash attention kernels ({attn_shape}, causal, bf16)",
+        "",
+        "| seq | pallas fwd ms | xla fwd ms | pallas bwd ms | xla bwd ms | dense fwd ms |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in attn_rows:
+        lines.append(
+            f"| {r['seq']} | {r['pallas_fwd_ms']} | {r['xla_fwd_ms']} | "
+            f"{r['pallas_bwd_ms']} | {r['xla_bwd_ms']} | "
+            f"{r.get('dense_fwd_ms', '—')} |")
+    lines += [""]
+    if trace_dir:
+        lines += [f"Profiler trace: `{trace_dir}` (TensorBoard/Perfetto).", ""]
+    lines += [
+        "Reproduce: `python scripts/tpu_perf.py` on the TPU host; "
+        "`--platform cpu --quick` for a plumbing check on the CPU mesh.",
+        "",
+    ]
+    with open("PERF.md", "w") as f:
+        f.write("\n".join(lines))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--skip-bench", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        # bench subprocesses: env-var platform selection is overridden by
+        # site hooks on some hosts, so bench.py honors this explicit knob
+        os.environ["BCFL_BENCH_PLATFORM"] = args.platform
+
+    import jax
+
+    device = jax.devices()[0].device_kind
+    print(f"device: {device}", flush=True)
+    bench_rows = [] if args.skip_bench else bench_sweep(args.trace_dir,
+                                                        args.quick)
+    attn_shape, attn_rows = attention_sweep(args.quick)
+    write_perf_md(device, bench_rows, attn_shape, attn_rows, args.trace_dir)
+    print("wrote PERF.md", flush=True)
+
+
+if __name__ == "__main__":
+    main()

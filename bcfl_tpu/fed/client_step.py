@@ -23,16 +23,18 @@ program is identical.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax, shard_map
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bcfl_tpu.core.mesh import ClientMesh
 from bcfl_tpu.models import lora as lora_lib
+from bcfl_tpu.parallel import gspmd
 from bcfl_tpu.parallel.collectives import gossip_mix, masked_weighted_mean
 
 Tree = Any
@@ -77,6 +79,52 @@ def make_loss_fn(model) -> Callable:
         return loss, (correct, ex.sum())
 
     return loss_fn
+
+
+def _unstack_rng(r):
+    # rngs arrive as stacked key-data uint32 [..., 2]; rebuild typed keys
+    return jax.random.wrap_key_data(r)
+
+
+def make_eval_one(loss_fn) -> Callable:
+    """(trainable, frozen, batches) -> summed [loss*n, correct, n] over the
+    scanned eval batches. Shared by both program implementations."""
+
+    def eval_one(trainable, frozen, batches):
+        def step(carry, batch):
+            loss, (correct, n) = loss_fn(trainable, frozen, batch, None)
+            return carry, jnp.stack([loss * n, correct, n])
+
+        _, stats = lax.scan(step, 0.0, batches)
+        return stats.sum(axis=0)
+
+    return eval_one
+
+
+def make_broadcast(mesh: ClientMesh) -> Callable:
+    """global tree -> stacked per-client tree [C, ...] on the clients axis."""
+
+    def broadcast(global_t):
+        return jax.device_put(
+            jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (mesh.num_clients,) + x.shape), global_t
+            ),
+            mesh.client_sharding(),
+        )
+
+    return broadcast
+
+
+def _exact_mean_spread(avg: Tree, new_t: Tree, mask: jnp.ndarray) -> Tree:
+    """Serverless exact-mean aggregation: every unmasked client adopts the
+    (mask-weighted) average, masked clients keep their own state. Shared by
+    both implementations' ``gossip_steps == 0`` path."""
+    return jax.tree.map(
+        lambda a, x: jnp.where(
+            mask.reshape((-1,) + (1,) * (x.ndim - 1)) > 0,
+            jnp.broadcast_to(a, x.shape), x),
+        avg, new_t,
+    )
 
 
 def make_local_train(tx, loss_fn) -> Callable:
@@ -143,7 +191,26 @@ def build_programs(
     # (halves peak HBM for the round-chained engine); leave False if you reuse
     # the input tree afterwards.
     donate: bool = False,
+    # Two numerically-identical implementations of the same programs:
+    #   "gspmd"     (default) — global stacked-client arrays under plain jit
+    #               with sharding annotations; XLA's SPMD partitioner inserts
+    #               the collectives. Measured ~200x faster than shard_map on
+    #               the tunnelled single-chip TPU platform (PERF.md).
+    #   "shard_map" — explicit psum/ppermute manual SPMD
+    #               (bcfl_tpu.parallel.collectives).
+    # Parity between them is pinned by tests/test_gspmd_impl.py. Override the
+    # default with BCFL_FED_IMPL.
+    impl: str = "auto",
 ) -> FedPrograms:
+    if impl == "auto":
+        impl = os.environ.get("BCFL_FED_IMPL", "gspmd")
+    if impl == "gspmd":
+        return _build_programs_gspmd(
+            model, mesh, optimizer=optimizer, learning_rate=learning_rate,
+            max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
+            gossip_steps=gossip_steps, donate=donate)
+    if impl != "shard_map":
+        raise ValueError(f"unknown fed impl {impl!r}")
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model)
     axis = mesh.axis
@@ -153,10 +220,6 @@ def build_programs(
 
     # ---- one client's local round: fresh opt state, scan over batches ----
     local_train = make_local_train(tx, loss_fn)
-
-    def _unstack_rng(r):
-        # rngs arrive as stacked key-data uint32 [..., 2]; rebuild typed keys
-        return jax.random.wrap_key_data(r)
 
     # ---- server mode: everyone trains from the SAME global trainable ----
     # single source of truth for one FedAvg round; the per-round program and
@@ -190,12 +253,7 @@ def build_programs(
         gossip_steps > 0 -> masked ring diffusion."""
         if gossip_steps == 0:
             avg = masked_weighted_mean(new_t, mask, axis, fallback=fallback)
-            return jax.tree.map(
-                lambda a, x: jnp.where(
-                    mask.reshape((-1,) + (1,) * (x.ndim - 1)) > 0,
-                    jnp.broadcast_to(a, x.shape), x),
-                avg, new_t,
-            )
+            return _exact_mean_spread(avg, new_t, mask)
         return gossip_mix(new_t, mask, gossip_alpha, axis, steps=gossip_steps)
 
     def gossip_shard(client_t, frozen, batches, mask, rngs):
@@ -306,13 +364,7 @@ def build_programs(
     single_update = jax.jit(local_train)
 
     # ---- evaluation ----
-    def eval_one(trainable, frozen, batches):
-        def step(carry, batch):
-            loss, (correct, n) = loss_fn(trainable, frozen, batch, None)
-            return carry, jnp.stack([loss * n, correct, n])
-
-        _, stats = lax.scan(step, 0.0, batches)
-        return stats.sum(axis=0)
+    eval_one = make_eval_one(loss_fn)
 
     def eval_clients_shard(client_t, frozen, batches):
         return jax.vmap(lambda t, b: eval_one(t, frozen, b))(client_t, batches)
@@ -341,13 +393,7 @@ def build_programs(
     eval_global = jax.jit(eval_one)
 
     # ---- layout helpers ----
-    def broadcast(global_t):
-        return jax.device_put(
-            jax.tree.map(
-                lambda x: jnp.broadcast_to(x[None], (mesh.num_clients,) + x.shape), global_t
-            ),
-            mesh.client_sharding(),
-        )
+    broadcast = make_broadcast(mesh)
 
     # ``fallback`` (replicated) is returned when every weight is zero — e.g. a
     # round where all clients fail ledger authentication must NOT aggregate
@@ -359,6 +405,141 @@ def build_programs(
             in_specs=(shard, shard, repl), out_specs=repl, check_vma=False,
         )
     )
+
+    return FedPrograms(
+        mesh=mesh,
+        server_round=server_round,
+        server_rounds=server_rounds,
+        server_rounds_static=server_rounds_static,
+        gossip_round=gossip_round,
+        eval_clients=eval_clients,
+        eval_clients_global=eval_clients_global,
+        eval_global=eval_global,
+        broadcast=broadcast,
+        collapse=collapse,
+        client_updates=client_updates,
+        local_updates=local_updates,
+        mix_only=mix_only,
+        single_update=single_update,
+    )
+
+
+def _build_programs_gspmd(
+    model,
+    mesh: ClientMesh,
+    optimizer: str = "adamw",
+    learning_rate: float = 5e-5,
+    max_grad_norm: float = 0.0,
+    gossip_alpha: float = 0.5,
+    gossip_steps: int = 1,
+    donate: bool = False,
+) -> FedPrograms:
+    """GSPMD twin of the shard_map builder: identical program signatures and
+    semantics (global stacked-client arrays in, global arrays out), but the
+    bodies are plain global-array math under ``jit`` with sharding
+    annotations — reductions/rolls over the sharded client dim become XLA
+    all-reduce / collective-permute (:mod:`bcfl_tpu.parallel.gspmd`)."""
+    tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
+    loss_fn = make_loss_fn(model)
+    local_train = make_local_train(tx, loss_fn)
+    jmesh = mesh.mesh
+    cl = NamedSharding(jmesh, P(mesh.axis))
+    rcl = NamedSharding(jmesh, P(None, mesh.axis))
+    repl = NamedSharding(jmesh, P())
+
+    def _c(tree, sh):
+        return jax.tree.map(lambda x: lax.with_sharding_constraint(x, sh), tree)
+
+    def _don(*idx):
+        return idx if donate else ()
+
+    # every client trains from the same replicated trainable
+    def train_clients(global_t, frozen, batches, rngs):
+        new_t, stats = jax.vmap(
+            lambda b, r: local_train(global_t, frozen, b, _unstack_rng(r))
+        )(batches, rngs)
+        return _c(new_t, cl), _c(stats, cl)
+
+    def server_body(global_t, frozen, batches, weights, rngs):
+        new_t, stats = train_clients(global_t, frozen, batches, rngs)
+        avg = gspmd.masked_weighted_mean(new_t, weights, fallback=global_t)
+        return _c(avg, repl), stats
+
+    server_round = jax.jit(server_body, donate_argnums=_don(0),
+                           out_shardings=(repl, cl))
+
+    def server_rounds_body(global_t, frozen, batches, weights, rngs):
+        def one_round(t, xs):
+            b, w, r = xs
+            avg, stats = server_body(t, frozen, b, w, r)
+            return avg, stats
+
+        return lax.scan(one_round, global_t, (batches, weights, rngs))
+
+    server_rounds = jax.jit(server_rounds_body, donate_argnums=_don(0),
+                            out_shardings=(repl, rcl))
+
+    def server_rounds_static_body(global_t, frozen, batches, weights, rngs):
+        def one_round(t, xs):
+            w, r = xs
+            return server_body(t, frozen, batches, w, r)
+
+        return lax.scan(one_round, global_t, (weights, rngs))
+
+    server_rounds_static = jax.jit(server_rounds_static_body,
+                                   donate_argnums=_don(0),
+                                   out_shardings=(repl, rcl))
+
+    def _mix_g(new_t, mask, fallback):
+        # same semantics as the shard_map _mix (see its docstring)
+        if gossip_steps == 0:
+            avg = gspmd.masked_weighted_mean(new_t, mask, fallback=fallback)
+            return _exact_mean_spread(avg, new_t, mask)
+        return gspmd.gossip_mix(new_t, mask, gossip_alpha, steps=gossip_steps)
+
+    # each client trains from its OWN stacked params
+    def local_updates_body(client_t, frozen, batches, rngs):
+        new_t, stats = jax.vmap(
+            lambda t, b, r: local_train(t, frozen, b, _unstack_rng(r))
+        )(client_t, batches, rngs)
+        return _c(new_t, cl), _c(stats, cl)
+
+    def gossip_body(client_t, frozen, batches, mask, rngs):
+        new_t, stats = local_updates_body(client_t, frozen, batches, rngs)
+        return _c(_mix_g(new_t, mask, client_t), cl), stats
+
+    gossip_round = jax.jit(gossip_body, donate_argnums=_don(0),
+                           out_shardings=(cl, cl))
+
+    client_updates = jax.jit(train_clients, out_shardings=(cl, cl))
+
+    local_updates = jax.jit(local_updates_body, out_shardings=(cl, cl))
+
+    mix_only = jax.jit(
+        lambda client_t, mask, fallback: _c(_mix_g(client_t, mask, fallback), cl),
+        out_shardings=cl)
+
+    single_update = jax.jit(local_train)
+
+    eval_one = make_eval_one(loss_fn)
+
+    eval_clients = jax.jit(
+        lambda client_t, frozen, b: _c(
+            jax.vmap(lambda t, bb: eval_one(t, frozen, bb))(client_t, b), cl),
+        out_shardings=cl)
+
+    eval_clients_global = jax.jit(
+        lambda g, f, b: _c(jax.vmap(lambda bb: eval_one(g, f, bb))(b), cl),
+        out_shardings=cl)
+
+    eval_global = jax.jit(eval_one)
+
+    broadcast = make_broadcast(mesh)
+
+    collapse = jax.jit(
+        lambda t, w, fallback: _c(
+            gspmd.masked_weighted_mean(t, w, fallback=fallback), repl),
+        out_shardings=repl)
 
     return FedPrograms(
         mesh=mesh,

@@ -4,6 +4,7 @@ from bcfl_tpu.parallel.collectives import (  # noqa: F401
     gossip_mix,
     mix_with_matrix,
 )
+from bcfl_tpu.parallel import gspmd  # noqa: F401
 from bcfl_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,

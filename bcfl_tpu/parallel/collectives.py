@@ -93,6 +93,16 @@ def ring_shift(tree: Tree, axis_name: str, direction: int = +1) -> Tree:
     return jax.tree.map(shift, tree)
 
 
+def gossip_step_mix(x, xl, xr, ml, mr, me, alpha: float):
+    """One client's masked ring-gossip update (masks already reshaped to
+    broadcast against ``x``). THE definition of the mixing rule — shared by
+    this module's shard_map ``gossip_mix`` and its GSPMD twin
+    (:func:`bcfl_tpu.parallel.gspmd.gossip_mix`), whose numeric parity the
+    default-impl switch depends on (``tests/test_gspmd_impl.py``)."""
+    mixed = x + (alpha / 2) * ml * (xl - x) + (alpha / 2) * mr * (xr - x)
+    return me * mixed + (1 - me) * x
+
+
 def gossip_mix(tree: Tree, mask: jnp.ndarray, alpha: float, axis_name: str,
                steps: int = 1) -> Tree:
     """Symmetric masked ring gossip: each client averages toward its two ring
@@ -119,8 +129,7 @@ def gossip_mix(tree: Tree, mask: jnp.ndarray, alpha: float, axis_name: str,
             ml = m_left.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
             mr = m_right.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
             me = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
-            mixed = x + (alpha / 2) * ml * (xl - x) + (alpha / 2) * mr * (xr - x)
-            return me * mixed + (1 - me) * x
+            return gossip_step_mix(x, xl, xr, ml, mr, me, alpha)
 
         tree = jax.tree.map(mix, tree, left, right)
     return tree

@@ -46,6 +46,9 @@ def bench_sweep(trace_dir=None, quick=False):
         env = dict(os.environ,
                    BCFL_BENCH_ROUNDS=str(rounds), BCFL_BENCH_STEPS=str(steps),
                    BCFL_BENCH_ITERS="2")
+        # a stale BCFL_BENCH_TRACE from the caller's env would make EVERY
+        # shape trace (overhead skews the rows); only the headline one traces
+        env.pop("BCFL_BENCH_TRACE", None)
         if trace_dir and (rounds, steps) == shapes[-1]:
             env["BCFL_BENCH_TRACE"] = trace_dir
         try:
@@ -190,7 +193,12 @@ def main(argv=None):
     print(f"device: {device}", flush=True)
     bench_rows = [] if args.skip_bench else bench_sweep(args.trace_dir,
                                                         args.quick)
-    attn_shape, attn_rows = attention_sweep(args.quick)
+    # an attention failure must not discard the completed bench evidence
+    try:
+        attn_shape, attn_rows = attention_sweep(args.quick)
+    except Exception as e:  # noqa: BLE001 — evidence must survive
+        print(f"attention sweep failed: {type(e).__name__}: {e}", flush=True)
+        attn_shape, attn_rows = f"FAILED: {type(e).__name__}: {e}", []
     write_perf_md(device, bench_rows, attn_shape, attn_rows, args.trace_dir)
     print("wrote PERF.md", flush=True)
 

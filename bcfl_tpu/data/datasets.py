@@ -237,10 +237,12 @@ def _imdb(num_labels: int = 2, **kw) -> TextDataset:
 
 @register_dataset("cancer")
 def _cancer(num_labels: int = 41, **kw) -> TextDataset:
-    """Reference: ``bhargavi909/cancer_classification``, ``input`` -> ``labels``
-    (``serverless_caner_classification_iid.py:49,53``)."""
+    """Reference: ``bhargavi909/cancer_classification``, text column ``input``
+    (``serverless_caner_classification_iid.py:49,53``); the hub label column
+    is ``label``, which the reference renames to ``labels``
+    (``serverless_caner_classification_iid.py:66``)."""
     return _load_hf_or_synthetic(
-        "bhargavi909/cancer_classification", text_col="input", label_col="labels",
+        "bhargavi909/cancer_classification", text_col="input", label_col="label",
         num_labels=num_labels, alias="cancer", **kw,
     )
 
@@ -321,8 +323,10 @@ def _load_hf(name: str, text_col: str = "text", label_col: str = "label",
 
     text_col = resolve(text_col, ("text", "sentence"))
     label_col = resolve(label_col, ("label", "labels"))
-    tr_y = np.asarray(train[label_col], dtype=np.int32)
-    te_y = np.asarray(test[label_col], dtype=np.int32)
+    # _map_labels handles int, integral-float (pandas NaN-upcast guard), and
+    # string label columns; train/test must share one mapping
+    tr_y, _, lut = _map_labels(train[label_col])
+    te_y, _, _ = _map_labels(test[label_col], lut)
     n = int(max(tr_y.max(), te_y.max())) + 1
     return TextDataset(
         alias or name,

@@ -1,0 +1,117 @@
+"""Column-mapping correctness for the HF-hub datasets against their real hub
+schemas, exercised OFFLINE via an in-memory hub mock.
+
+The zero-egress environment means ``imdb`` / ``cancer`` / ``covid`` normally
+fall back to synthetic stand-ins, so until now the column mappings in
+``bcfl_tpu.data.datasets`` (VERDICT r02 weak #8) were untested. Schemas
+mirrored here come from the reference's own column usage:
+
+- imdb:   ``text`` -> ``label`` (int)        (``server_IID_IMDB.py:66,79``)
+- cancer: ``input`` -> ``label`` (int; reference renames label->labels,
+          ``serverless_caner_classification_iid.py:53,66``)
+- covid:  ``text`` -> ``sentiment``          (``serverless_covid_iid.py:49,66``)
+"""
+
+import numpy as np
+import pytest
+
+import bcfl_tpu.data.datasets as D
+
+
+def _mock_hub(monkeypatch, columns):
+    import datasets as hf
+
+    calls = {}
+
+    def fake_load_dataset(name, *a, **k):
+        calls["name"] = name
+        return hf.DatasetDict({
+            split: hf.Dataset.from_dict(cols)
+            for split, cols in columns.items()
+        })
+
+    monkeypatch.setattr(hf, "load_dataset", fake_load_dataset)
+    return calls
+
+
+def test_imdb_schema(monkeypatch):
+    calls = _mock_hub(monkeypatch, {
+        "train": {"text": ["good movie", "bad movie", "fine movie"],
+                  "label": [1, 0, 1]},
+        "test": {"text": ["great", "awful"], "label": [1, 0]},
+    })
+    ds = D.load_dataset("imdb")
+    assert calls["name"] == "imdb"
+    assert ds.name == "imdb"  # NOT the ":synthetic-standin" marker
+    assert ds.train_texts == ["good movie", "bad movie", "fine movie"]
+    np.testing.assert_array_equal(ds.train_labels, [1, 0, 1])
+    np.testing.assert_array_equal(ds.test_labels, [1, 0])
+    assert ds.num_labels == 2
+
+
+def test_cancer_schema(monkeypatch):
+    calls = _mock_hub(monkeypatch, {
+        "train": {"input": ["pathology report a", "report b"],
+                  "label": [3, 40]},
+        "test": {"input": ["report c"], "label": [7]},
+    })
+    ds = D.load_dataset("cancer")
+    assert calls["name"] == "bhargavi909/cancer_classification"
+    assert ds.name == "cancer"
+    assert ds.train_texts[0] == "pathology report a"
+    np.testing.assert_array_equal(ds.train_labels, [3, 40])
+    assert ds.num_labels == 41
+
+
+def test_covid_schema_int_sentiment(monkeypatch):
+    _mock_hub(monkeypatch, {
+        "train": {"text": ["tweet a", "tweet b"], "sentiment": [0, 2]},
+        "test": {"text": ["tweet c"], "sentiment": [1]},
+    })
+    ds = D.load_dataset("covid")
+    assert ds.name == "covid"
+    np.testing.assert_array_equal(ds.train_labels, [0, 2])
+    assert ds.num_labels == 41  # reference trains covid with num_labels=41
+
+
+def test_covid_schema_string_sentiment(monkeypatch):
+    """String label columns map by sorted unique value, shared train/test."""
+    _mock_hub(monkeypatch, {
+        "train": {"text": ["a", "b", "c"],
+                  "sentiment": ["positive", "negative", "neutral"]},
+        "test": {"text": ["d"], "sentiment": ["positive"]},
+    })
+    ds = D.load_dataset("covid", num_labels=0)
+    # sorted unique: negative=0, neutral=1, positive=2
+    np.testing.assert_array_equal(ds.train_labels, [2, 0, 1])
+    np.testing.assert_array_equal(ds.test_labels, [2])
+    assert ds.num_labels == 3
+
+
+def test_unseen_test_label_is_loud(monkeypatch):
+    _mock_hub(monkeypatch, {
+        "train": {"text": ["a"], "sentiment": ["positive"]},
+        "test": {"text": ["b"], "sentiment": ["mystery"]},
+    })
+    with pytest.warns(UserWarning, match="synthetic stand-in"):
+        ds = D.load_dataset("covid")  # falls back loudly, never silently maps
+    assert ds.name.endswith(":synthetic-standin")
+
+
+def test_missing_test_split_reuses_train(monkeypatch):
+    _mock_hub(monkeypatch, {
+        "train": {"text": ["a", "b"], "label": [0, 1]},
+    })
+    ds = D.load_dataset("imdb")
+    assert ds.n_test == ds.n_train == 2
+
+
+def test_column_resolution_fallback(monkeypatch):
+    """A hub dataset using 'sentence'/'labels' still resolves."""
+    _mock_hub(monkeypatch, {
+        "train": {"sentence": ["a", "b"], "labels": [0, 1]},
+        "test": {"sentence": ["c"], "labels": [1]},
+    })
+    ds = D.load_dataset("imdb")
+    assert ds.train_texts == ["a", "b"]
+    np.testing.assert_array_equal(ds.train_labels, [0, 1])

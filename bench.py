@@ -182,5 +182,50 @@ def main():
         sys.exit(1)
 
 
+def _run_with_retries() -> int:
+    """Run the bench body in a child process, retrying on failure.
+
+    A wedged tunnel at backend-init never recovers within a process, but a
+    fresh process minutes later often does (observed twice in r03). The
+    child is this same file with BCFL_BENCH_CHILD=1; only its final JSON
+    line is re-emitted, so the driver still sees exactly ONE JSON line.
+    """
+    import subprocess
+
+    attempts = int(os.environ.get("BCFL_BENCH_RETRIES", "2")) + 1
+    delay = float(os.environ.get("BCFL_BENCH_RETRY_DELAY_S", "300"))
+    last_line = None
+    for i in range(attempts):
+        env = dict(os.environ, BCFL_BENCH_CHILD="1")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True)
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        if lines:
+            last_line = lines[-1]
+        failed = proc.returncode != 0
+        try:
+            failed = failed or "error" in json.loads(last_line or "{}")
+        except json.JSONDecodeError:
+            failed = True
+        if not failed:
+            print(last_line, flush=True)
+            return 0
+        print(f"bench attempt {i + 1}/{attempts} failed "
+              f"(rc={proc.returncode}): "
+              f"{(last_line or proc.stderr[-300:] or 'no output')[:300]}",
+              file=sys.stderr, flush=True)
+        if i < attempts - 1:
+            time.sleep(delay)
+    if last_line:
+        print(last_line, flush=True)  # the error JSON — evidence survives
+    else:
+        _error_json("child", "bench child produced no output")
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BCFL_BENCH_CHILD"):
+        main()
+    else:
+        sys.exit(_run_with_retries())

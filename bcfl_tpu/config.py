@@ -155,6 +155,10 @@ class FedConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # rounds; 0 = off
     eval_every: int = 1
+    # cap the central-eval set to this many batches (None = the full test
+    # split, the reference's evaluate_global_model behaviour); small hosts
+    # use a cap so per-round eval doesn't dominate wall-clock
+    max_eval_batches: Optional[int] = None
     # jax.profiler trace output dir (TensorBoard/Perfetto); None = off.
     # The reference's only profiling is psutil+wall-clock (SURVEY.md §5).
     profile_dir: Optional[str] = None

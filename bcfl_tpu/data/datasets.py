@@ -212,8 +212,8 @@ def _medical(
 ) -> TextDataset:
     """Reference: ``bhargavi909/Medical_Transcriptions_upsampled`` on the hub
     (``src/Servercase/server_iid_medical_transcirptions.py:48``); its on-disk
-    twin is ``Dataset/train_file_mt.csv`` (12,021 rows) / ``test_file_mt.csv``
-    (3,003 rows) with ``description`` -> ``medical_specialty``."""
+    twin is ``Dataset/train_file_mt.csv`` (12,000 records) / ``test_file_mt.csv``
+    (3,000 records) with ``description`` -> ``medical_specialty``."""
     tr = os.path.join(data_dir, "train_file_mt.csv")
     te = os.path.join(data_dir, "test_file_mt.csv")
     if not (os.path.exists(tr) and os.path.exists(te)):

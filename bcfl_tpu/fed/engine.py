@@ -161,7 +161,8 @@ class FedEngine:
 
         self.ledger = Ledger(cfg.ledger.use_native) if cfg.ledger.enabled else None
         self.eval_batches = jax.tree.map(
-            jnp.asarray, central_eval_batches(self.cache, cfg.batch_size))
+            jnp.asarray, central_eval_batches(self.cache, cfg.batch_size,
+                                              max_batches=cfg.max_eval_batches))
         self._static_batches = None  # cache when the partition is round-static
 
     # ------------------------------------------------------------------ utils

@@ -2,7 +2,7 @@
 
 Runs the Medical-Transcriptions experiments — the one reference dataset whose
 data ships on disk (``/root/reference/Dataset/{train,test}_file_mt.csv``,
-12,021/3,003 rows, 40 specialties; SURVEY.md C20) — through the two preset
+12,000/3,000 records, 40 specialties; SURVEY.md C20) — through the two preset
 configurations whose published curves are BASELINE.md's Medical table:
 
 - ``server_iid_medical``       (reference ``server_iid_medical_transcirptions.py``)
@@ -47,12 +47,26 @@ def main(argv=None):
     ap.add_argument("--model", default="small-bert")
     ap.add_argument("--clients", type=int, default=10)
     ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--seq-len", type=int, default=0,
+                    help="0 = the preset default (128, the reference "
+                    "configuration — always use this with --hf: WordPiece "
+                    "expands medical terms ~1.5-2x, so short caps truncate "
+                    "the tail). With the offline word-level hash tokenizer "
+                    "the MT descriptions fit in 96 (p99 = 54 words), so "
+                    "64-96 is a sound CPU-host speedup there only.")
+    ap.add_argument("--eval-batches", type=int, default=0,
+                    help="cap central eval batches per round (0 = full "
+                    "3,000-row test split, the reference behaviour)")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--hf", action="store_true")
     ap.add_argument("--out", default="results")
     ap.add_argument("--configs", nargs="*", default=None,
                     help="subset of config names to run")
     args = ap.parse_args(argv)
+    if args.eval_batches < 0:
+        ap.error("--eval-batches must be >= 0")
+    if args.seq_len < 0:
+        ap.error("--seq-len must be >= 0")
 
     # On a CPU mesh the XLA collective rendezvous aborts the whole process if
     # any device thread lags >40s behind the others (rendezvous.cc terminate
@@ -78,7 +92,10 @@ def main(argv=None):
     os.makedirs(args.out, exist_ok=True)
 
     common = dict(model=args.model, num_clients=args.clients,
-                  num_rounds=args.rounds)
+                  num_rounds=args.rounds,
+                  max_eval_batches=args.eval_batches or None)
+    if args.seq_len:
+        common["seq_len"] = args.seq_len
 
     configs = {
         "server_iid_medical": get_preset(
@@ -113,6 +130,8 @@ def main(argv=None):
             "hf_weights": bool(args.hf),
             "clients": args.clients,
             "rounds": args.rounds,
+            "seq_len": cfg.seq_len,
+            "max_eval_batches": cfg.max_eval_batches,
             "final_acc": accs[-1] if accs else None,
             "best_acc": max(accs) if accs else None,
             "acc_curve": accs,
@@ -145,8 +164,8 @@ def _write_results_md(args, summary):
         "# RESULTS — real-data runs (Medical Transcriptions)",
         "",
         "Dataset: the reference's on-disk CSVs "
-        "(`/root/reference/Dataset/train_file_mt.csv` 12,021 rows / "
-        "`test_file_mt.csv` 3,003 rows, 40 medical specialties — the only "
+        "(`/root/reference/Dataset/train_file_mt.csv` 12,000 records / "
+        "`test_file_mt.csv` 3,000 records, 40 medical specialties — the only "
         "reference dataset whose data ships in the repo; SURVEY.md C20). "
         "Loaded by `bcfl_tpu.data.datasets`, tokenized once, static-shape "
         "batches.",
@@ -165,10 +184,16 @@ def _write_results_md(args, summary):
             "experiment.",
             "",
         ]
+    any_s = next(iter(summary.values()), {})
+    eval_cap = any_s.get("max_eval_batches")
     lines += [
         f"Configuration: {args.clients} clients x {args.rounds} rounds, "
-        "reference partition schedules (IID 500-random resampled/round for "
-        "server; Non-IID contiguous 500i/400 with fixed test slice for "
+        f"seq_len {any_s.get('seq_len', '?')} "
+        f"(reference: 128), central eval "
+        + (f"capped at {eval_cap} batches/round"
+           if eval_cap else "on the full test split")
+        + ", reference partition schedules (IID 500-random resampled/round "
+        "for server; Non-IID contiguous 500i/400 with fixed test slice for "
         "serverless — SURVEY.md §2.1).",
         "",
         "| run | final acc | best acc | reference (BioBERT) final | model GB "

@@ -16,27 +16,43 @@ from bcfl_tpu.fed.engine import FedEngine, RunResult
 
 
 def run(cfg: FedConfig, resume: bool = False, verbose: bool = True) -> RunResult:
-    engine = FedEngine(cfg)
-    result = engine.run(resume=resume)
     if verbose:
-        print(format_report(cfg, result))
+        print("\n".join(_header(cfg)), flush=True)
+    engine = FedEngine(cfg)
+    result = engine.run(resume=resume,
+                        on_round=_print_round if verbose else None)
+    if verbose:
+        print(format_report(cfg, result, rounds=False, header=False))
     return result
 
 
-def format_report(cfg: FedConfig, result: RunResult) -> str:
-    m = result.metrics
-    lines = [
+def _header(cfg: FedConfig) -> list:
+    return [
         f"== {cfg.name} ==",
         f"mode={cfg.mode} sync={cfg.sync} clients={cfg.num_clients} "
         f"rounds={cfg.num_rounds} model={cfg.model} dataset={cfg.dataset}",
     ]
-    for r in m.rounds:
-        acc = f" global_acc={r.global_acc:.4f}" if r.global_acc is not None else ""
-        anom = f" anomalies={r.anomalies}" if r.anomalies else ""
-        lines.append(
-            f"round {r.round:3d}: train_loss={r.train_loss:.4f} "
-            f"train_acc={r.train_acc:.4f}{acc}{anom} wall={r.wall_s:.2f}s"
-        )
+
+
+def _round_line(r) -> str:
+    acc = f" global_acc={r.global_acc:.4f}" if r.global_acc is not None else ""
+    anom = f" anomalies={r.anomalies}" if r.anomalies else ""
+    return (f"round {r.round:3d}: train_loss={r.train_loss:.4f} "
+            f"train_acc={r.train_acc:.4f}{acc}{anom} wall={r.wall_s:.2f}s")
+
+
+def _print_round(r) -> None:
+    print(_round_line(r), flush=True)
+
+
+def format_report(cfg: FedConfig, result: RunResult, rounds: bool = True,
+                  header: bool = True) -> str:
+    """rounds=False / header=False omit the per-round lines / header (already
+    streamed live by run(verbose=True) via the engine's on_round callback)."""
+    m = result.metrics
+    lines = _header(cfg) if header else []
+    if rounds:
+        lines.extend(_round_line(r) for r in m.rounds)
     # reference metric names (server_IID_IMDB.py:221-233, with the reversed
     # before/after memory naming fixed — SURVEY.md C11)
     lines.append(m.summary())

@@ -229,11 +229,13 @@ class FedEngine:
 
     # ------------------------------------------------------------------- run
 
-    def run(self, resume: bool = False) -> RunResult:
+    def run(self, resume: bool = False, on_round=None) -> RunResult:
+        """on_round: optional callable(RoundRecord), invoked after each round
+        record is finalized (long runs are otherwise silent until the end)."""
         with trace(self.cfg.profile_dir):
-            return self._run(resume)
+            return self._run(resume, on_round)
 
-    def _run(self, resume: bool = False) -> RunResult:
+    def _run(self, resume: bool = False, on_round=None) -> RunResult:
         cfg = self.cfg
         monitor = ResourceMonitor()
         metrics = RunMetrics()
@@ -277,6 +279,9 @@ class FedEngine:
                 self._maybe_eval(last_rnd, recs[-1], trainable, stacked, clock)
                 metrics.rounds.extend(recs)
                 self._maybe_checkpoint(last_rnd, trainable, stacked)
+                if on_round is not None:
+                    for r in recs:
+                        on_round(r)
                 rnd += chunk
                 continue
 
@@ -311,6 +316,8 @@ class FedEngine:
             self._maybe_eval(rnd, rec, trainable, stacked, clock)
             metrics.rounds.append(rec)
             self._maybe_checkpoint(rnd, trainable, stacked)
+            if on_round is not None:
+                on_round(rec)
             rnd += 1
 
         params = _merge(trainable, self.frozen)

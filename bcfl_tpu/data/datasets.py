@@ -65,13 +65,29 @@ def register_dataset(name: str):
 def load_dataset(name: str, **kw) -> TextDataset:
     if name.startswith("csv:"):
         return _load_csv_spec(name[4:], **kw)
-    if name in _REGISTRY:
+    # "name+variant" selects a loader's augmentation variant from config
+    # (e.g. "self_driving_sentiment+ctgan"); only loaders that declare an
+    # ``augmented`` parameter accept one
+    base, plus, variant = name.partition("+")
+    if plus and not variant:
+        raise ValueError(f"dataset name {name!r} has a trailing '+' with no "
+                         "variant")
+    if base in _REGISTRY:
         # registry datasets own their reference column mappings (SURVEY.md
         # §2.1 matrix); config-level text_col/label_col only applies to
         # csv:/hub datasets
         kw.pop("text_col", None)
         kw.pop("label_col", None)
-        return _REGISTRY[name](**kw)
+        if variant:
+            import inspect
+
+            params = inspect.signature(_REGISTRY[base]).parameters
+            if "augmented" not in params:
+                raise ValueError(
+                    f"dataset {base!r} has no augmentation variants "
+                    f"(got {name!r})")
+            kw["augmented"] = variant
+        return _REGISTRY[base](**kw)
     return _load_hf(name, **kw)
 
 

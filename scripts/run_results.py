@@ -100,7 +100,7 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", args.platform)
 
-    from bcfl_tpu.config import LedgerConfig, TopologyConfig
+    from bcfl_tpu.config import LedgerConfig, PartitionConfig, TopologyConfig
     from bcfl_tpu.entrypoints.presets import get_preset
     from bcfl_tpu.entrypoints.run import run
     from bcfl_tpu.viz.plots import accuracy_curves
@@ -128,6 +128,19 @@ def main(argv=None):
                 topology=TopologyConfig(anomaly_filter="pagerank"),
                 ledger=LedgerConfig(enabled=True)),
     }
+    # augmentation study (SURVEY.md C20): the second real on-disk corpus —
+    # self-driving sentiment, 500 rows — federated with and without the
+    # reference's CTGAN augmentation file appended to the train split.
+    # Small corpus => small federation: 4 clients x 100 IID samples/round.
+    sdv_common = dict(common, num_clients=4)
+    for aug in ("", "+ctgan"):
+        key = "sdv_serverless_iid" + aug.replace("+", "_")
+        configs[key] = get_preset(
+            "serverless_covid_iid", hf=args.hf).replace(
+                **sdv_common, name=key,
+                dataset="self_driving_sentiment" + aug, num_labels=3,
+                partition=PartitionConfig(
+                    kind="iid", iid_samples=100, resample_each_round=True))
     if args.configs:
         configs = {k: v for k, v in configs.items() if k in args.configs}
 
@@ -150,8 +163,8 @@ def main(argv=None):
         summary[name] = {
             "model": args.model,
             "hf_weights": bool(args.hf),
-            "clients": args.clients,
-            "rounds": args.rounds,
+            "clients": cfg.num_clients,
+            "rounds": cfg.num_rounds,
             "seq_len": cfg.seq_len,
             "max_eval_batches": cfg.max_eval_batches,
             "platform": platform,
@@ -170,9 +183,17 @@ def main(argv=None):
               f"{summary[name]['final_acc']}, wall {wall/60:.1f} min",
               flush=True)
 
-    with open(os.path.join(args.out, "summary.json"), "w") as f:
+    # merge into any existing summary so partial runs (--configs subsets)
+    # accumulate instead of clobbering earlier results
+    spath = os.path.join(args.out, "summary.json")
+    if os.path.exists(spath):
+        with open(spath) as f:
+            merged = json.load(f)
+        merged.update(summary)
+        summary = merged
+    with open(spath, "w") as f:
         json.dump(summary, f, indent=2)
-    print(f"\nwrote {args.out}/summary.json", flush=True)
+    print(f"\nwrote {spath}", flush=True)
     _render(args, summary, accuracy_curves)
 
 
@@ -180,7 +201,7 @@ def _render(args, summary, accuracy_curves):
     curves = {n: s["acc_curve"] for n, s in summary.items() if s["acc_curve"]}
     if curves:
         accuracy_curves(
-            curves, title="Medical Transcriptions: global accuracy vs round",
+            curves, title="Real-data runs: global accuracy vs round",
             path=os.path.join(args.out, "medical_accuracy_curves.png"))
     _write_results_md(args, summary)
     print(f"wrote RESULTS.md (+figures in {args.out}/)", flush=True)
@@ -197,14 +218,15 @@ def _write_results_md(args, summary):
     clients = any_s.get("clients", args.clients)
     rounds = any_s.get("rounds", args.rounds)
     lines = [
-        "# RESULTS — real-data runs (Medical Transcriptions)",
+        "# RESULTS — real-data runs",
         "",
-        "Dataset: the reference's on-disk CSVs "
+        "Datasets: the reference's on-disk CSVs (SURVEY.md C20) — "
+        "Medical Transcriptions "
         "(`/root/reference/Dataset/train_file_mt.csv` 12,000 records / "
-        "`test_file_mt.csv` 3,000 records, 40 medical specialties — the only "
-        "reference dataset whose data ships in the repo; SURVEY.md C20). "
-        "Loaded by `bcfl_tpu.data.datasets`, tokenized once, static-shape "
-        "batches.",
+        "`test_file_mt.csv` 3,000 records, 40 medical specialties) and the "
+        "self-driving sentiment corpus (500 records, 3 classes, plus its "
+        "CTGAN/Copula/shuffle augmentation files). Loaded by "
+        "`bcfl_tpu.data.datasets`, tokenized once, static-shape batches.",
         "",
     ]
     if not hf:
@@ -286,6 +308,25 @@ def _write_results_md(args, summary):
             f"{fmt(bc.get('info_passing_async_s'), '.2f')}s vs the "
             "reference's modeled 28.96s / 3.62s for the 0.043 GB payload "
             "class).",
+            "",
+        ]
+    sdv = summary.get("sdv_serverless_iid")
+    sdv_aug = summary.get("sdv_serverless_iid_ctgan")
+    if sdv and sdv_aug:
+        lines += [
+            "## Synthetic-data augmentation on the self-driving corpus",
+            "",
+            "The reference ships CTGAN/GaussianCopula/random-shuffle "
+            "augmentation files for its 500-row self-driving sentiment CSV "
+            "but never trains on them (SURVEY.md C20). Here both runs are "
+            "federated for real (serverless IID, 4 clients x "
+            f"{sdv.get('rounds', '?')} rounds, 100 samples/client/round; "
+            "the test split is always held out from the real rows): "
+            f"plain corpus final acc {fmt(sdv.get('final_acc'), '.3f')} vs "
+            "+CTGAN-augmented train split "
+            f"{fmt(sdv_aug.get('final_acc'), '.3f')} "
+            f"(best {fmt(sdv.get('best_acc'), '.3f')} vs "
+            f"{fmt(sdv_aug.get('best_acc'), '.3f')}).",
             "",
         ]
     with open("RESULTS.md", "w") as f:

@@ -1,0 +1,172 @@
+"""Client-count scaling study: convergence vs federation size.
+
+BASELINE.json's north-star metric is "samples/sec/chip + rounds-to-target
+accuracy as the federation scales 4 -> 64 clients". This script measures the
+convergence half on any host: serverless IID federated runs of the same model
+over a geometric ladder of client counts, recording each count's global
+accuracy-vs-round curve, the first round at which it crosses a fixed accuracy
+threshold, and aggregate training throughput.
+
+The per-client data budget is held constant (``--iid-samples`` per client per
+round, the reference's resample-per-round schedule,
+``src/Serverlesscase/serverless_IID_IMDB.py:258``), so scaling clients scales
+the total per-round sample budget — the classic FL trade: more clients = more
+data seen per round but a more averaged (less sequential) update.
+
+On TPU each client is a mesh slot (one chip, or stacked clients per chip), so
+wall-clock per round is ~flat as counts grow with the mesh; on this CPU host
+the counts share one core, so wall-clock numbers here are NOT the scaling
+story — rounds-to-threshold is. Emits ``<out>/scaling.json`` +
+``<out>/scaling_curves.png`` and rewrites ``SCALING.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def first_crossing(curve, threshold):
+    """1-based round index of the first curve point >= threshold, else None."""
+    for i, a in enumerate(curve):
+        if a >= threshold:
+            return i + 1
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--counts", type=int, nargs="*", default=[4, 16, 64])
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--model", default="tiny-bert")
+    ap.add_argument("--dataset", default="medical_transcriptions")
+    ap.add_argument("--num-labels", type=int, default=40)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--iid-samples", type=int, default=128,
+                    help="per-client per-round sample budget (constant "
+                    "across counts; total budget scales with the count)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="accuracy whose first crossing is reported; with "
+                    "fresh-init tiny models pick a reachable level, on a "
+                    "pretrained run use the reference's 0.9-of-final")
+    ap.add_argument("--eval-batches", type=int, default=16)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--out", default="results")
+    args = ap.parse_args(argv)
+
+    # multi-client CPU meshes on a loaded host abort when a device thread
+    # lags >40s behind the XLA collective rendezvous; raise the timeouts
+    # BEFORE the backend initializes (same setup as run_results.py)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "collective_call_terminate" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+            " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.entrypoints.run import run
+    from bcfl_tpu.viz.plots import accuracy_curves
+
+    os.makedirs(args.out, exist_ok=True)
+    study = {}
+    for count in args.counts:
+        name = f"scale_{count}c"
+        cfg = FedConfig(
+            name=name, model=args.model, dataset=args.dataset,
+            num_labels=args.num_labels, mode="serverless",
+            weighted_agg=False, num_clients=count, num_rounds=args.rounds,
+            seq_len=args.seq_len, max_eval_batches=args.eval_batches,
+            partition=PartitionConfig(
+                kind="iid", iid_samples=args.iid_samples,
+                resample_each_round=True),
+        )
+        print(f"\n===== {name} =====", flush=True)
+        t0 = time.time()
+        res = run(cfg, verbose=True)
+        wall = time.time() - t0
+        accs = res.metrics.global_accuracies
+        samples = count * args.iid_samples * args.rounds
+        study[count] = {
+            "acc_curve": accs,
+            "final_acc": accs[-1] if accs else None,
+            "best_acc": max(accs) if accs else None,
+            "rounds_to_threshold": first_crossing(accs, args.threshold),
+            "threshold": args.threshold,
+            "train_samples_total": samples,
+            "wall_minutes": wall / 60.0,
+            "samples_per_sec_aggregate": samples / wall,
+        }
+        print(f"[{name}] best acc {study[count]['best_acc']}, "
+              f"rounds-to-{args.threshold}: "
+              f"{study[count]['rounds_to_threshold']}", flush=True)
+
+    meta = {"model": args.model, "dataset": args.dataset,
+            "seq_len": args.seq_len, "iid_samples": args.iid_samples,
+            "rounds": args.rounds, "threshold": args.threshold,
+            "counts": args.counts}
+    with open(os.path.join(args.out, "scaling.json"), "w") as f:
+        json.dump({"meta": meta, "runs": study}, f, indent=2)
+    accuracy_curves(
+        {f"{c} clients": s["acc_curve"] for c, s in study.items()},
+        title="Scaling: global accuracy vs round by client count",
+        path=os.path.join(args.out, "scaling_curves.png"))
+    _write_md(meta, study)
+    print(f"\nwrote {args.out}/scaling.json and SCALING.md", flush=True)
+
+
+def _write_md(meta, study):
+    lines = [
+        "# SCALING — convergence vs federation size",
+        "",
+        "The north-star scaling metric (BASELINE.json): rounds-to-target "
+        "accuracy as the federation grows 4 -> 64 clients, constant "
+        "per-client data budget "
+        f"({meta['iid_samples']} IID samples/client/round, resampled per "
+        "round — the reference's schedule). Serverless mode, "
+        f"`{meta['model']}` on `{meta['dataset']}`, seq_len "
+        f"{meta['seq_len']}, {meta['rounds']} rounds.",
+        "",
+        "On TPU each client is a mesh slot, so wall-clock per round stays "
+        "~flat as counts grow with the mesh (the multichip dryrun compiles "
+        "exactly this program); on a CPU host all counts share the cores, "
+        "so the scaling signal below is rounds-to-threshold and the "
+        "curves, not wall-clock.",
+        "",
+        f"| clients | best acc | final acc | rounds to {meta['threshold']} "
+        "| total train samples | wall min |",
+        "|---|---|---|---|---|---|",
+    ]
+    def fmt(v, spec):
+        return format(v, spec) if v is not None else "—"
+
+    for c, s in study.items():
+        rt = s["rounds_to_threshold"]
+        lines.append(
+            f"| {c} | {fmt(s['best_acc'], '.3f')} | "
+            f"{fmt(s['final_acc'], '.3f')} | "
+            f"{rt if rt is not None else 'not reached'} | "
+            f"{s['train_samples_total']} | {fmt(s['wall_minutes'], '.1f')} |")
+    lines += [
+        "",
+        "Curves: `results/scaling_curves.png`; raw data "
+        "`results/scaling.json`. Reproduce: `python scripts/run_scaling.py` "
+        "(add `--counts 4 8 16 32 64 --threshold 0.9` on a pretrained-"
+        "weights host).",
+        "",
+    ]
+    with open("SCALING.md", "w") as f:
+        f.write("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,28 @@ def test_self_driving_sentiment_real_csv():
         load_dataset("self_driving_sentiment", augmented="gan2")
 
 
+def test_dataset_plus_variant_suffix():
+    """config-level ``name+variant`` selects a loader's augmentation variant
+    (the only way a FedConfig.dataset string can reach ``augmented=``)."""
+    import os
+
+    from bcfl_tpu.data.datasets import REFERENCE_DATASET_DIR, load_dataset
+
+    if not os.path.exists(os.path.join(
+            REFERENCE_DATASET_DIR,
+            "sentiment_analysis_self_driving_vehicles.csv")):
+        pytest.skip("reference dataset dir not mounted")
+    plain = load_dataset("self_driving_sentiment")
+    aug = load_dataset("self_driving_sentiment+ctgan")
+    assert aug.n_train == plain.n_train + 500
+    assert aug.n_test == plain.n_test
+    # loaders without an ``augmented`` parameter reject variants loudly
+    with pytest.raises(ValueError, match="no augmentation variants"):
+        load_dataset("imdb+ctgan")
+    with pytest.raises(ValueError, match="unknown augmentation"):
+        load_dataset("self_driving_sentiment+gan2")
+
+
 def test_map_labels_float_column_guard():
     """pandas upcasts an int label column with a missing value to float;
     lexicographic string-mapping of '10.0' vs '2.0' would silently corrupt

@@ -121,10 +121,11 @@ class FedConfig:
     local_epochs: int = 1  # reference: 1 epoch per round (server_IID_IMDB.py:172)
     max_local_batches: Optional[int] = None  # cap scan length (static shape)
     # fuse up to this many federated rounds into ONE XLA dispatch when the
-    # host isn't needed between them (server mode, sync, no ledger, no
-    # anomaly filter) — amortizes dispatch/transfer overhead, which dominates
-    # on tunnelled or high-latency hosts. Chunks never cross an eval or
-    # checkpoint boundary, so observable cadence is unchanged.
+    # host isn't needed between them (sync server FedAvg or sync parallel
+    # serverless gossip — not faithful mode; no ledger, no anomaly filter) —
+    # amortizes dispatch/transfer overhead, which dominates on tunnelled or
+    # high-latency hosts. Chunks never cross an eval or checkpoint boundary,
+    # so observable cadence is unchanged.
     rounds_per_dispatch: int = 1
     # True  = example-weighted FedAvg (Flower's aggregate, server mode)
     # False = unweighted mean (reference serverless ":296" semantics)

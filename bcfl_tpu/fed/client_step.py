@@ -164,6 +164,8 @@ class FedPrograms:
     server_rounds: Callable  # R rounds in one program; batches/weights/rngs leaves [R, C, ...]
     server_rounds_static: Callable  # same, ONE batch tree [C, ...] reused every round
     gossip_round: Callable  # (client_t, frozen, batches, mask, rngs) -> (client_t, metrics)
+    gossip_rounds: Callable  # R gossip rounds in one program; batches/masks/rngs leaves [R, C, ...]
+    gossip_rounds_static: Callable  # same, ONE batch tree [C, ...] reused every round
     eval_clients: Callable  # (client_t, frozen, batches) -> per-client [C, 3] stats
     eval_clients_global: Callable  # (global_t, frozen, batches) -> per-client [C, 3] stats
     eval_global: Callable  # (trainable, frozen, batches) -> [loss*n, correct, n]
@@ -320,6 +322,42 @@ def build_programs(
         donate_argnums=(0,) if donate else (),
     )
 
+    # serverless twin of the multi-round fast path: R gossip rounds scanned
+    # on-device, per-client params carried in HBM across the whole block
+    def gossip_rounds_shard(client_t, frozen, batches, masks, rngs):
+        def one_round(t, xs):
+            b, m, r = xs
+            return gossip_shard(t, frozen, b, m, r)
+
+        return lax.scan(one_round, client_t, (batches, masks, rngs))
+
+    gossip_rounds = jax.jit(
+        shard_map(
+            gossip_rounds_shard, mesh=jmesh,
+            in_specs=(shard, repl, rshard, rshard, rshard),
+            out_specs=(shard, rshard),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def gossip_rounds_static_shard(client_t, frozen, batches, masks, rngs):
+        def one_round(t, xs):
+            m, r = xs
+            return gossip_shard(t, frozen, batches, m, r)
+
+        return lax.scan(one_round, client_t, (masks, rngs))
+
+    gossip_rounds_static = jax.jit(
+        shard_map(
+            gossip_rounds_static_shard, mesh=jmesh,
+            in_specs=(shard, repl, shard, rshard, rshard),
+            out_specs=(shard, rshard),
+            check_vma=False,
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+
     # ---- split-phase programs (ledger commit/verify flow, async engine) ----
     def client_updates_shard(global_t, frozen, batches, rngs):
         new_t, stats = jax.vmap(
@@ -412,6 +450,8 @@ def build_programs(
         server_rounds=server_rounds,
         server_rounds_static=server_rounds_static,
         gossip_round=gossip_round,
+        gossip_rounds=gossip_rounds,
+        gossip_rounds_static=gossip_rounds_static,
         eval_clients=eval_clients,
         eval_clients_global=eval_clients_global,
         eval_global=eval_global,
@@ -511,6 +551,27 @@ def _build_programs_gspmd(
     gossip_round = jax.jit(gossip_body, donate_argnums=_don(0),
                            out_shardings=(cl, cl))
 
+    def gossip_rounds_body(client_t, frozen, batches, masks, rngs):
+        def one_round(t, xs):
+            b, m, r = xs
+            return gossip_body(t, frozen, b, m, r)
+
+        return lax.scan(one_round, client_t, (batches, masks, rngs))
+
+    gossip_rounds = jax.jit(gossip_rounds_body, donate_argnums=_don(0),
+                            out_shardings=(cl, rcl))
+
+    def gossip_rounds_static_body(client_t, frozen, batches, masks, rngs):
+        def one_round(t, xs):
+            m, r = xs
+            return gossip_body(t, frozen, batches, m, r)
+
+        return lax.scan(one_round, client_t, (masks, rngs))
+
+    gossip_rounds_static = jax.jit(gossip_rounds_static_body,
+                                   donate_argnums=_don(0),
+                                   out_shardings=(cl, rcl))
+
     client_updates = jax.jit(train_clients, out_shardings=(cl, cl))
 
     local_updates = jax.jit(local_updates_body, out_shardings=(cl, cl))
@@ -547,6 +608,8 @@ def _build_programs_gspmd(
         server_rounds=server_rounds,
         server_rounds_static=server_rounds_static,
         gossip_round=gossip_round,
+        gossip_rounds=gossip_rounds,
+        gossip_rounds_static=gossip_rounds_static,
         eval_clients=eval_clients,
         eval_clients_global=eval_clients_global,
         eval_global=eval_global,

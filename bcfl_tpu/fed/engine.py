@@ -273,7 +273,12 @@ class FedEngine:
             if chunk > 1:
                 t0 = time.time()
                 with clock.phase("round_program"):
-                    trainable, recs = self._server_chunk(rnd, trainable, chunk)
+                    if cfg.mode == "server":
+                        trainable, recs = self._server_chunk(
+                            rnd, trainable, chunk)
+                    else:
+                        stacked, trainable, recs = self._serverless_chunk(
+                            rnd, stacked, trainable, chunk)
                 self._annotate_chunk(recs, time.time() - t0)
                 last_rnd = rnd + chunk - 1
                 self._maybe_eval(last_rnd, recs[-1], trainable, stacked, clock)
@@ -374,13 +379,15 @@ class FedEngine:
         """How many rounds starting at ``rnd`` can fuse into one dispatch.
 
         Eligible only when the host has nothing to do between rounds: sync
-        server FedAvg, no ledger commit/verify, no anomaly filter (the mask
-        is all-ones), no tamper hook. Chunks never cross an eval or
-        checkpoint boundary, so the observable cadence is identical to the
-        per-round path."""
+        server FedAvg or sync parallel serverless gossip (NOT the faithful
+        host-sequential mode), no ledger commit/verify, no anomaly filter
+        (the mask is all-ones), no tamper hook. Chunks never cross an eval
+        or checkpoint boundary, so the observable cadence is identical to
+        the per-round path."""
         cfg = self.cfg
         k = cfg.rounds_per_dispatch
-        if (k <= 1 or cfg.mode != "server" or cfg.sync != "sync"
+        if (k <= 1 or cfg.sync != "sync"
+                or (cfg.mode != "server" and cfg.faithful)
                 or self.ledger is not None or self.tamper_hook is not None
                 or cfg.topology.anomaly_filter is not None):
             return 1
@@ -391,34 +398,77 @@ class FedEngine:
             k = min(k, cfg.checkpoint_every - rnd % cfg.checkpoint_every)
         return max(k, 1)
 
-    def _server_chunk(self, rnd: int, trainable, k: int):
-        """Run rounds [rnd, rnd+k) in ONE XLA dispatch via server_rounds."""
-        cfg = self.cfg
-        ones = np.ones((cfg.num_clients,), np.float32)
-        batch_list, weight_list, rng_list = [], [], []
+    def _chunk_inputs(self, rnd: int, k: int):
+        """Stage batches/rngs/example-counts for rounds [rnd, rnd+k).
+
+        Returns ``(static, batches, rrngs, n_ex_list)``: ``static=True``
+        means ONE batch tree [C, ...] reused every round (round-static
+        partition cache hit — stacking k identical copies would be a k-fold
+        HBM blowup for no information), else ``batches`` is the stacked
+        [k, C, ...] tree."""
+        batch_list, rng_list, n_ex_list = [], [], []
         for r in range(rnd, rnd + k):
             b, n_ex = self._round_batches(r)
             batch_list.append(b)
-            weight_list.append(np.asarray(
-                ones * (n_ex if cfg.weighted_agg else 1.0), np.float32))
+            n_ex_list.append(n_ex)
             rng_list.append(self._rngs(r))
-        rweights = self.mesh.shard_round_clients(
-            jnp.asarray(np.stack(weight_list)))
         rrngs = self.mesh.shard_round_clients(
             jnp.stack([jnp.asarray(r) for r in rng_list]))
         if all(b is batch_list[0] for b in batch_list):
-            # round-static partition (cache hit): ONE batch tree on device
-            # instead of k identical stacked copies
-            trainable, stats = self.progs.server_rounds_static(
-                trainable, self.frozen, batch_list[0], rweights, rrngs)
-        else:
-            rbatches = self.mesh.shard_round_clients(
-                jax.tree.map(lambda *xs: jnp.stack(xs), *batch_list))
-            trainable, stats = self.progs.server_rounds(
-                trainable, self.frozen, rbatches, rweights, rrngs)
+            return True, batch_list[0], rrngs, n_ex_list
+        rbatches = self.mesh.shard_round_clients(
+            jax.tree.map(lambda *xs: jnp.stack(xs), *batch_list))
+        return False, rbatches, rrngs, n_ex_list
+
+    def _server_chunk(self, rnd: int, trainable, k: int):
+        """Run rounds [rnd, rnd+k) in ONE XLA dispatch via server_rounds."""
+        cfg = self.cfg
+        static, batches, rrngs, n_ex_list = self._chunk_inputs(rnd, k)
+        rweights = self.mesh.shard_round_clients(jnp.asarray(np.stack([
+            np.full((cfg.num_clients,),
+                    n_ex if cfg.weighted_agg else 1.0, np.float32)
+            for n_ex in n_ex_list])))
+        prog = (self.progs.server_rounds_static if static
+                else self.progs.server_rounds)
+        trainable, stats = prog(trainable, self.frozen, batches, rweights,
+                                rrngs)
         stats = np.asarray(stats)  # [k, C, 3]
         return trainable, [self._stats_to_rec(rnd + i, stats[i])
                            for i in range(k)]
+
+    def _serverless_chunk(self, rnd, stacked, prev_consensus, k):
+        """Run gossip rounds [rnd, rnd+k) in ONE dispatch via gossip_rounds.
+
+        Only reached with an all-ones participation mask (``_chunk_rounds``
+        rejects filters/ledger/tamper), so the consensus view for eval/
+        checkpoint is computed once at the chunk end — the per-round
+        consensus values it skips are unobservable (no eval inside a
+        chunk)."""
+        cfg = self.cfg
+        static, batches, rrngs, _ = self._chunk_inputs(rnd, k)
+        masks = self.mesh.shard_round_clients(
+            jnp.ones((k, cfg.num_clients), jnp.float32))
+        prog = (self.progs.gossip_rounds_static if static
+                else self.progs.gossip_rounds)
+        stacked, stats = prog(stacked, self.frozen, batches, masks, rrngs)
+        # collapse (a full-tree consensus all-reduce + host round-trip) only
+        # when this chunk's end is observable — an eval round, a checkpoint
+        # round, or the end of the run; otherwise the value would be
+        # discarded, re-paying the dispatch overhead fusing exists to avoid
+        last = rnd + k - 1
+        observed = (
+            last == cfg.num_rounds - 1
+            or (cfg.eval_every and (last + 1) % cfg.eval_every == 0)
+            or (cfg.checkpoint_dir and cfg.checkpoint_every
+                and (last + 1) % cfg.checkpoint_every == 0))
+        consensus = prev_consensus
+        if observed:
+            m = self.mesh.shard_clients(
+                jnp.ones((cfg.num_clients,), jnp.float32))
+            consensus = self.progs.collapse(stacked, m, prev_consensus)
+        stats = np.asarray(stats)  # [k, C, 3]
+        return stacked, consensus, [self._stats_to_rec(rnd + i, stats[i])
+                                    for i in range(k)]
 
     def _annotate_chunk(self, recs, wall: float) -> None:
         """Participation/info-passing fields for fused rounds (all-ones mask

@@ -254,11 +254,16 @@ def test_rounds_per_dispatch_matches_per_round_path():
 
 
 def test_rounds_per_dispatch_ineligible_configs_fall_back():
-    """Ledger / anomaly-filter / serverless configs must silently use the
-    per-round path (the host is needed between rounds)."""
-    cfg = _cfg(mode="serverless", num_rounds=2, rounds_per_dispatch=8)
+    """Ledger / anomaly-filter / faithful / async configs must silently use
+    the per-round path (the host is needed between rounds); parallel sync
+    serverless IS eligible (gossip_rounds)."""
+    cfg = _cfg(mode="serverless", num_rounds=2, rounds_per_dispatch=8,
+               eval_every=2)
     eng = FedEngine(cfg)
-    assert eng._chunk_rounds(0) == 1
+    assert eng._chunk_rounds(0) == 2  # bounded by remaining rounds
+    cfg_f = _cfg(mode="serverless", num_rounds=2, rounds_per_dispatch=8,
+                 faithful=True)
+    assert FedEngine(cfg_f)._chunk_rounds(0) == 1
     cfg2 = _cfg(mode="server", num_rounds=2, rounds_per_dispatch=8,
                 ledger=LedgerConfig(enabled=True))
     assert FedEngine(cfg2)._chunk_rounds(0) == 1
@@ -284,3 +289,47 @@ def test_rounds_per_dispatch_resampled_partition():
     rk = FedEngine(base.replace(rounds_per_dispatch=2)).run()
     np.testing.assert_allclose(
         rk.metrics.global_accuracies, r1.metrics.global_accuracies, atol=1e-6)
+
+
+def test_serverless_chunk_lazy_consensus_end_of_run():
+    """With eval and checkpointing off, fused chunks skip the consensus
+    collapse entirely until the final round — the end-of-run trainable must
+    still match the per-round path."""
+    import jax
+
+    base = _cfg(mode="serverless", num_rounds=4, eval_every=0)
+    r1 = FedEngine(base).run()
+    rk = FedEngine(base.replace(rounds_per_dispatch=2)).run()
+    for a, b in zip(jax.tree.leaves(jax.device_get(rk.trainable)),
+                    jax.tree.leaves(jax.device_get(r1.trainable))):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_serverless_rounds_per_dispatch_matches_per_round_path():
+    """Fused gossip rounds (gossip_rounds / gossip_rounds_static) must
+    reproduce the per-round serverless path: same per-client params, same
+    consensus accuracies, same eval cadence, on both the round-static and
+    resampled partitions."""
+    import jax
+
+    for part in (PartitionConfig(kind="iid", iid_samples=64),
+                 PartitionConfig(kind="iid", iid_samples=64,
+                                 resample_each_round=True)):
+        base = _cfg(mode="serverless", num_rounds=4, eval_every=2,
+                    partition=part)
+        r1 = FedEngine(base).run()
+        rk = FedEngine(base.replace(rounds_per_dispatch=4)).run()
+        assert len(rk.metrics.rounds) == 4
+        evald = [r.round for r in rk.metrics.rounds
+                 if r.global_acc is not None]
+        assert evald == [1, 3]
+        np.testing.assert_allclose(
+            rk.metrics.global_accuracies, r1.metrics.global_accuracies,
+            atol=1e-6)
+        for a, b in zip(jax.tree.leaves(jax.device_get(rk.trainable)),
+                        jax.tree.leaves(jax.device_get(r1.trainable))):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+        for ra, rb in zip(rk.metrics.rounds, r1.metrics.rounds):
+            assert ra.round == rb.round
+            np.testing.assert_allclose(ra.train_loss, rb.train_loss,
+                                       rtol=1e-4)

@@ -70,6 +70,41 @@ def test_gossip_round_parity(gossip_steps):
     assert _max_diff(s1, s2) < 1e-3
 
 
+def test_gossip_rounds_parity():
+    """The fused multi-round gossip program (R rounds scanned on-device)
+    agrees across impls and with R sequential gossip_round calls."""
+    R = 2
+    mesh, sm, gs, params, batches, weights, rngs = _setup(8)
+    mask = weights.at[3].set(0.0)
+    rb = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (R,) + x.shape), batches)
+    rm = jnp.broadcast_to(mask[None], (R,) + mask.shape)
+    rr = jnp.stack([rngs, jax.vmap(jax.random.fold_in)(
+        rngs, jnp.full((rngs.shape[0],), 7, jnp.uint32))])
+
+    p1, s1 = sm.gossip_rounds(sm.broadcast(params), None, rb, rm, rr)
+    p2, s2 = gs.gossip_rounds(gs.broadcast(params), None, rb, rm, rr)
+    assert _max_diff(p1, p2) < 1e-5
+    assert _max_diff(s1, s2) < 1e-3
+
+    # sequential oracle: R gossip_round calls
+    seq = gs.broadcast(params)
+    for i in range(R):
+        seq, _ = gs.gossip_round(
+            seq, None, jax.tree.map(lambda x: x[i], rb), rm[i], rr[i])
+    assert _max_diff(p2, seq) < 1e-5
+
+    # static variant (one batch tree reused every round), both impls
+    p3, s3 = gs.gossip_rounds_static(
+        gs.broadcast(params), None, batches, rm, rr)
+    assert _max_diff(p2, p3) < 1e-5
+    assert _max_diff(s2, s3) < 1e-3
+    p4, s4 = sm.gossip_rounds_static(
+        sm.broadcast(params), None, batches, rm, rr)
+    assert _max_diff(p3, p4) < 1e-5
+    assert _max_diff(s3, s4) < 1e-3
+
+
 def test_split_phase_parity():
     mesh, sm, gs, params, batches, weights, rngs = _setup(8)
     u1, s1 = sm.client_updates(params, None, batches, rngs)

@@ -23,7 +23,9 @@ unguarded failure, and the r03 session saw multi-hour init hangs).
 Env knobs: BCFL_BENCH_TRACE=<dir> captures a jax.profiler trace of the timed
 block; BCFL_BENCH_ROUNDS/STEPS/ITERS override the shape;
 BCFL_BENCH_PLATFORM=<platform> redirects the backend via jax.config (the
-JAX_PLATFORMS env var is overridden by site hooks on some hosts).
+JAX_PLATFORMS env var is overridden by site hooks on some hosts);
+BCFL_BENCH_MODE=serverless times the fused gossip program (gossip_rounds —
+per-client params held in HBM across the block) instead of server FedAvg.
 """
 
 from __future__ import annotations
@@ -41,6 +43,7 @@ SEQ = 128
 ROUNDS = int(os.environ.get("BCFL_BENCH_ROUNDS", "8"))  # fed rounds / dispatch
 STEPS = int(os.environ.get("BCFL_BENCH_STEPS", "8"))  # local batches / round
 ITERS = int(os.environ.get("BCFL_BENCH_ITERS", "2"))  # timed dispatches
+MODE = os.environ.get("BCFL_BENCH_MODE", "server")  # server | serverless
 STAGE_TIMEOUT_S = 1200.0  # per STAGE, reset on every stage transition
 
 PEAK_FLOPS = {  # bf16 peak matmul throughput per chip
@@ -56,9 +59,14 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def _metric_name():
+    tag = "serverless_" if MODE == "serverless" else ""
+    return f"bert-base_fed_{tag}finetune_samples_per_sec_per_chip"
+
+
 def _error_json(stage: str, err: str):
     _emit({
-        "metric": "bert-base_fed_finetune_samples_per_sec_per_chip",
+        "metric": _metric_name(),
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
@@ -96,6 +104,12 @@ class _Watchdog:
 
 def main():
     watchdog = _Watchdog(STAGE_TIMEOUT_S)
+    if MODE not in ("server", "serverless"):
+        # fail fast: a typo'd mode silently timing the wrong program would
+        # be a multi-hour TPU run of worthless evidence
+        _error_json("config", f"unknown BCFL_BENCH_MODE {MODE!r}; "
+                    "expected 'server' or 'serverless'")
+        sys.exit(1)
     watchdog.stage("backend-init")
 
     try:
@@ -141,10 +155,28 @@ def main():
         rweights = jnp.broadcast_to(weights[None], (ROUNDS,) + weights.shape)
         rrngs = jnp.broadcast_to(rngs[None], (ROUNDS,) + rngs.shape)
 
+        if MODE == "serverless":
+            # per-client stacked params carried across fused gossip rounds;
+            # jitted broadcast — the eager per-leaf version dispatches
+            # hundreds of host ops over the tunnel (same reason init is
+            # jitted above)
+            watchdog.stage("broadcast")
+            carry = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x[None], (num_clients,) + x.shape), p),
+                out_shardings=mesh.client_sharding())(params)
+            jax.block_until_ready(carry)
+            run_block = lambda c: progs.gossip_rounds(  # noqa: E731
+                c, None, rbatches, rweights, rrngs)[0]
+        else:
+            carry = params
+            run_block = lambda c: progs.server_rounds(  # noqa: E731
+                c, None, rbatches, rweights, rrngs)[0]
+
         watchdog.stage("compile")
-        params, stats = progs.server_rounds(
-            params, None, rbatches, rweights, rrngs)
-        jax.block_until_ready(params)
+        carry = run_block(carry)
+        jax.block_until_ready(carry)
 
         watchdog.stage("measure")
         trace_dir = os.environ.get("BCFL_BENCH_TRACE")
@@ -152,9 +184,8 @@ def main():
             jax.profiler.start_trace(trace_dir)
         t0 = time.perf_counter()
         for _ in range(ITERS):
-            params, stats = progs.server_rounds(
-                params, None, rbatches, rweights, rrngs)
-        jax.block_until_ready(params)
+            carry = run_block(carry)
+        jax.block_until_ready(carry)
         dt = time.perf_counter() - t0
         if trace_dir:
             jax.profiler.stop_trace()
@@ -163,7 +194,7 @@ def main():
         sps_chip = samples / dt / n_dev
         flops = 6.0 * n_params * samples * SEQ
         out = {
-            "metric": "bert-base_fed_finetune_samples_per_sec_per_chip",
+            "metric": _metric_name(),
             "value": round(sps_chip, 2),
             "unit": "samples/sec/chip",
             "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC, 2),

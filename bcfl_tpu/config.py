@@ -113,6 +113,16 @@ class FedConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
 
+    # --- scale-out (SURVEY.md §2.5: the two axes the reference lacks) ---
+    # tensor-parallel shards per client: tp > 1 builds a 2-D (clients, tp)
+    # mesh, shards the FROZEN base megatron-style (requires lora_rank > 0 —
+    # adapters stay per-client), and runs the same GSPMD round programs
+    tp: int = 1
+    # build the mesh over every host in the pod (jax.distributed must be
+    # initialized first — core.mesh.distributed_init); devices are ordered
+    # hosts-major so collectives ride ICI and cross DCN once
+    pod: bool = False
+
     # --- federated topology ---
     mode: str = "server"  # "server" (centralized FedAvg) | "serverless" (P2P gossip)
     sync: str = "sync"  # "sync" | "async" (host-scheduled, staleness-weighted)
@@ -171,6 +181,13 @@ class FedConfig:
             raise ValueError(f"unknown sync: {self.sync!r}")
         if self.num_clients < 1 or self.num_rounds < 1:
             raise ValueError("num_clients and num_rounds must be >= 1")
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.tp > 1 and self.lora_rank <= 0:
+            raise ValueError(
+                "tp > 1 tensor-shards the FROZEN base and keeps per-client "
+                "LoRA adapters; set lora_rank > 0 (full fine-tune is 1-D "
+                "clients-only)")
 
     def replace(self, **kw) -> "FedConfig":
         return dataclasses.replace(self, **kw)

@@ -31,16 +31,25 @@ def _largest_divisor_leq(n: int, cap: int) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class ClientMesh:
-    """A 1-D mesh over ``n_devices`` devices hosting ``num_clients`` clients.
+    """A mesh hosting ``num_clients`` clients on its ``clients`` axis.
 
-    ``per_device`` clients are stacked on each device (leading array dim);
-    collectives over :data:`CLIENT_AXIS` combine across devices, a reduction
-    over the stacked dim combines within a device.
+    ``per_device`` clients are stacked on each clients-axis shard (leading
+    array dim); collectives over :data:`CLIENT_AXIS` combine across shards, a
+    reduction over the stacked dim combines within a shard.
+
+    ``tp > 1`` makes the mesh 2-D ``(clients, tp)``: each client's
+    forward/backward spans ``tp`` chips via megatron tensor-parallel param
+    shardings (``bcfl_tpu.models.tp_param_specs``) on the FROZEN base, while
+    per-client arrays stay ``P(clients)`` (replicated over tp). The same
+    GSPMD round programs run unchanged — XLA inserts the tp collectives from
+    the sharding annotations (this is the composition the reference cannot
+    express at all: many clients x a model bigger than one chip).
     """
 
     mesh: Mesh
     num_clients: int
     per_device: int
+    tp: int = 1
 
     @property
     def n_devices(self) -> int:
@@ -138,9 +147,9 @@ def pod_devices() -> list:
     return list(grid.reshape(-1))
 
 
-def pod_client_mesh(num_clients: int) -> ClientMesh:
+def pod_client_mesh(num_clients: int, tp: int = 1) -> ClientMesh:
     """clients mesh spanning every host in the pod (see :func:`pod_devices`)."""
-    return client_mesh(num_clients, devices=pod_devices())
+    return client_mesh(num_clients, devices=pod_devices(), tp=tp)
 
 
 def fed_tp_mesh(client_shards: int, tp: int,
@@ -163,6 +172,7 @@ def fed_tp_mesh(client_shards: int, tp: int,
 def client_mesh(
     num_clients: int,
     devices: Optional[Sequence[jax.Device]] = None,
+    tp: int = 1,
 ) -> ClientMesh:
     """Build the clients mesh.
 
@@ -170,8 +180,24 @@ def client_mesh(
     count, so any client count runs on any device count (num_clients=10 on 8
     CPU devices -> 5 mesh devices x 2 stacked clients; 32 clients on a v5e-32
     -> 1 client per chip, the BASELINE.json north star).
+
+    ``tp > 1`` reserves that many devices per client shard on an inner ``tp``
+    axis (2-D ``(clients, tp)`` mesh — tp innermost so a client's
+    tensor-parallel collectives ride adjacent-ICI links; see
+    :class:`ClientMesh`).
     """
     devices = list(devices if devices is not None else jax.devices())
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if tp > 1:
+        if len(devices) < tp:
+            raise ValueError(
+                f"tp={tp} needs at least tp devices, have {len(devices)}")
+        d = _largest_divisor_leq(num_clients, len(devices) // tp)
+        mesh = Mesh(np.asarray(devices[:d * tp]).reshape(d, tp),
+                    (CLIENT_AXIS, "tp"))
+        return ClientMesh(mesh=mesh, num_clients=num_clients,
+                          per_device=num_clients // d, tp=tp)
     d = _largest_divisor_leq(num_clients, len(devices))
     mesh = Mesh(np.array(devices[:d]), (CLIENT_AXIS,))
     return ClientMesh(mesh=mesh, num_clients=num_clients, per_device=num_clients // d)

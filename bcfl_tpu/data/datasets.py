@@ -81,6 +81,11 @@ def load_dataset(name: str, **kw) -> TextDataset:
         if variant:
             import inspect
 
+            if "augmented" in kw:
+                raise ValueError(
+                    f"dataset {name!r} has a +variant suffix AND an explicit "
+                    f"augmented={kw['augmented']!r} kwarg; pass one or the "
+                    "other")
             params = inspect.signature(_REGISTRY[base]).parameters
             if "augmented" not in params:
                 raise ValueError(

@@ -37,7 +37,17 @@ def main(argv=None):
     ap.add_argument("--max-local-batches", type=int, default=None)
     ap.add_argument("--rounds-per-dispatch", type=int, default=None,
                     help="fuse up to N federated rounds into one XLA dispatch "
-                         "(sync server mode without ledger/filter only)")
+                         "(sync server FedAvg or parallel gossip; the ledger "
+                         "fuses too via in-graph fingerprints — only anomaly "
+                         "filters, tamper hooks, and faithful mode fall back "
+                         "to per-round)")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel shards per client (2-D clients x tp "
+                         "mesh; requires --lora-rank > 0)")
+    ap.add_argument("--pod", action="store_true",
+                    help="span the mesh over every host in the pod "
+                         "(jax.distributed must be initialized; see "
+                         "core.mesh.distributed_init)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--faithful", action="store_true",
                     help="reference-exact sequential serverless semantics")
@@ -68,7 +78,7 @@ def main(argv=None):
         "seq_len": "seq_len", "batch_size": "batch_size",
         "lr": "learning_rate", "lora_rank": "lora_rank",
         "max_local_batches": "max_local_batches", "seed": "seed",
-        "rounds_per_dispatch": "rounds_per_dispatch",
+        "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
     }
     overrides = {}
@@ -91,6 +101,8 @@ def main(argv=None):
         overrides["topology"] = dataclasses.replace(cfg.topology, anomaly_filter=f)
     if args.ledger:
         overrides["ledger"] = dataclasses.replace(cfg.ledger, enabled=True)
+    if args.pod:
+        overrides["pod"] = True
     cfg = cfg.replace(**overrides)
 
     if args.sweep:

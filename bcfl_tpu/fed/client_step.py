@@ -33,6 +33,7 @@ from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bcfl_tpu.core.mesh import ClientMesh
+from bcfl_tpu.ledger.fingerprint import client_fingerprint, tree_fingerprint
 from bcfl_tpu.models import lora as lora_lib
 from bcfl_tpu.parallel import gspmd
 from bcfl_tpu.parallel.collectives import gossip_mix, masked_weighted_mean
@@ -179,6 +180,16 @@ class FedPrograms:
     single_update: Callable  # (trainable, frozen, batches, rng) -> (trainable, stats);
     # un-shard_mapped single client, used by the reference-faithful sequential
     # serverless mode (SURVEY.md §3.2)
+    # device-side ledger digests (bcfl_tpu.ledger.fingerprint) — [C, K] / [K]
+    # content fingerprints so the ledger never pulls the full tree to host:
+    fingerprint: Optional[Callable] = None  # stacked client_t -> [C, K]
+    fingerprint_one: Optional[Callable] = None  # trainable -> [K]
+    # fused-round twins that ALSO emit each round's per-client update
+    # fingerprints [R, C, K] (gspmd impl only — the ledger can then fuse):
+    server_rounds_fp: Optional[Callable] = None
+    server_rounds_static_fp: Optional[Callable] = None
+    gossip_rounds_fp: Optional[Callable] = None
+    gossip_rounds_static_fp: Optional[Callable] = None
 
 
 def build_programs(
@@ -213,6 +224,12 @@ def build_programs(
             gossip_steps=gossip_steps, donate=donate)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
+    if getattr(mesh, "tp", 1) > 1:
+        # the manual-SPMD twin would replicate each client's compute over the
+        # tp axis instead of sharding it; only GSPMD composes clients x tp
+        raise ValueError(
+            "clients x tp meshes require impl='gspmd' (unset BCFL_FED_IMPL "
+            "or set it to 'gspmd' when tp > 1)")
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model)
     axis = mesh.axis
@@ -461,6 +478,10 @@ def build_programs(
         local_updates=local_updates,
         mix_only=mix_only,
         single_update=single_update,
+        # impl-agnostic (plain global-array math); the fused *_fp twins are
+        # gspmd-only, so a ledger run under shard_map falls back per-round
+        fingerprint=jax.jit(lambda t: client_fingerprint(t)),
+        fingerprint_one=jax.jit(lambda t: tree_fingerprint(t)),
     )
 
 
@@ -508,27 +529,36 @@ def _build_programs_gspmd(
     server_round = jax.jit(server_body, donate_argnums=_don(0),
                            out_shardings=(repl, cl))
 
-    def server_rounds_body(global_t, frozen, batches, weights, rngs):
-        def one_round(t, xs):
-            b, w, r = xs
-            avg, stats = server_body(t, frozen, b, w, r)
-            return avg, stats
+    def _make_server_rounds(static: bool, with_fp: bool):
+        """Fused R-round server program; ``with_fp=True`` additionally emits
+        each round's per-client update fingerprints [R, C, K] (computed on
+        the pre-aggregation update, exactly what the split-phase ledger flow
+        digests) so the ledger commit needs no per-round host round-trip."""
 
-        return lax.scan(one_round, global_t, (batches, weights, rngs))
+        def body(global_t, frozen, batches, weights, rngs):
+            def one_round(t, xs):
+                if static:
+                    w, r = xs
+                    b = batches
+                else:
+                    b, w, r = xs
+                new_t, stats = train_clients(t, frozen, b, r)
+                avg = _c(gspmd.masked_weighted_mean(new_t, w, fallback=t),
+                         repl)
+                out = ((stats, _c(client_fingerprint(new_t), cl))
+                       if with_fp else stats)
+                return avg, out
 
-    server_rounds = jax.jit(server_rounds_body, donate_argnums=_don(0),
-                            out_shardings=(repl, rcl))
+            xs = (weights, rngs) if static else (batches, weights, rngs)
+            return lax.scan(one_round, global_t, xs)
 
-    def server_rounds_static_body(global_t, frozen, batches, weights, rngs):
-        def one_round(t, xs):
-            w, r = xs
-            return server_body(t, frozen, batches, w, r)
+        out_sh = (repl, (rcl, rcl)) if with_fp else (repl, rcl)
+        return jax.jit(body, donate_argnums=_don(0), out_shardings=out_sh)
 
-        return lax.scan(one_round, global_t, (weights, rngs))
-
-    server_rounds_static = jax.jit(server_rounds_static_body,
-                                   donate_argnums=_don(0),
-                                   out_shardings=(repl, rcl))
+    server_rounds = _make_server_rounds(static=False, with_fp=False)
+    server_rounds_static = _make_server_rounds(static=True, with_fp=False)
+    server_rounds_fp = _make_server_rounds(static=False, with_fp=True)
+    server_rounds_static_fp = _make_server_rounds(static=True, with_fp=True)
 
     def _mix_g(new_t, mask, fallback):
         # same semantics as the shard_map _mix (see its docstring)
@@ -551,26 +581,34 @@ def _build_programs_gspmd(
     gossip_round = jax.jit(gossip_body, donate_argnums=_don(0),
                            out_shardings=(cl, cl))
 
-    def gossip_rounds_body(client_t, frozen, batches, masks, rngs):
-        def one_round(t, xs):
-            b, m, r = xs
-            return gossip_body(t, frozen, b, m, r)
+    def _make_gossip_rounds(static: bool, with_fp: bool):
+        """Fused R-round gossip program; ``with_fp`` emits each round's
+        post-train pre-mix per-client fingerprints [R, C, K] (the tree the
+        split-phase ledger flow commits via ``local_updates``)."""
 
-        return lax.scan(one_round, client_t, (batches, masks, rngs))
+        def body(client_t, frozen, batches, masks, rngs):
+            def one_round(t, xs):
+                if static:
+                    m, r = xs
+                    b = batches
+                else:
+                    b, m, r = xs
+                new_t, stats = local_updates_body(t, frozen, b, r)
+                mixed = _c(_mix_g(new_t, m, t), cl)
+                out = ((stats, _c(client_fingerprint(new_t), cl))
+                       if with_fp else stats)
+                return mixed, out
 
-    gossip_rounds = jax.jit(gossip_rounds_body, donate_argnums=_don(0),
-                            out_shardings=(cl, rcl))
+            xs = (masks, rngs) if static else (batches, masks, rngs)
+            return lax.scan(one_round, client_t, xs)
 
-    def gossip_rounds_static_body(client_t, frozen, batches, masks, rngs):
-        def one_round(t, xs):
-            m, r = xs
-            return gossip_body(t, frozen, batches, m, r)
+        out_sh = (cl, (rcl, rcl)) if with_fp else (cl, rcl)
+        return jax.jit(body, donate_argnums=_don(0), out_shardings=out_sh)
 
-        return lax.scan(one_round, client_t, (masks, rngs))
-
-    gossip_rounds_static = jax.jit(gossip_rounds_static_body,
-                                   donate_argnums=_don(0),
-                                   out_shardings=(cl, rcl))
+    gossip_rounds = _make_gossip_rounds(static=False, with_fp=False)
+    gossip_rounds_static = _make_gossip_rounds(static=True, with_fp=False)
+    gossip_rounds_fp = _make_gossip_rounds(static=False, with_fp=True)
+    gossip_rounds_static_fp = _make_gossip_rounds(static=True, with_fp=True)
 
     client_updates = jax.jit(train_clients, out_shardings=(cl, cl))
 
@@ -619,4 +657,11 @@ def _build_programs_gspmd(
         local_updates=local_updates,
         mix_only=mix_only,
         single_update=single_update,
+        fingerprint=jax.jit(lambda t: _c(client_fingerprint(t), cl),
+                            out_shardings=cl),
+        fingerprint_one=jax.jit(lambda t: tree_fingerprint(t)),
+        server_rounds_fp=server_rounds_fp,
+        server_rounds_static_fp=server_rounds_static_fp,
+        gossip_rounds_fp=gossip_rounds_fp,
+        gossip_rounds_static_fp=gossip_rounds_static_fp,
     )

@@ -1,1 +1,7 @@
 from bcfl_tpu.ledger.ledger import Ledger, LedgerEntry, params_digest  # noqa: F401
+from bcfl_tpu.ledger.fingerprint import (  # noqa: F401
+    client_fingerprint,
+    entry_digest,
+    struct_digest,
+    tree_fingerprint,
+)

@@ -48,16 +48,9 @@ def _leaf_bytes(path, leaf) -> Tuple[bytes, bytes]:
     return header, np.ascontiguousarray(arr).tobytes()
 
 
-def params_digest(tree, use_native: bool = True) -> bytes:
-    """Canonical SHA-256 of a parameter tree (leaf names + dtypes + shapes +
-    raw bytes, in tree order) — what a client announces to the ledger."""
-    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-    chunks: List[bytes] = []
-    for path, leaf in flat:
-        header, body = _leaf_bytes(path, leaf)
-        chunks.append(header)
-        chunks.append(body)
-
+def _sha256_chunks(chunks: List[bytes], use_native: bool = True) -> bytes:
+    """SHA-256 over concatenated chunks — C++ core when built, hashlib
+    otherwise (identical digests either way)."""
     lib = load_ledger_lib() if use_native else None
     if lib is not None:
         import ctypes
@@ -72,6 +65,21 @@ def params_digest(tree, use_native: bool = True) -> bytes:
     for c in chunks:
         h.update(c)
     return h.digest()
+
+
+def params_digest(tree, use_native: bool = True) -> bytes:
+    """Canonical SHA-256 of a parameter tree (leaf names + dtypes + shapes +
+    raw bytes, in tree order) — what a client announces to the ledger.
+    Requires the full tree on host; the engine's default commit path instead
+    hashes a device-computed fingerprint
+    (:mod:`bcfl_tpu.ledger.fingerprint`) so the tree never leaves HBM."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    chunks: List[bytes] = []
+    for path, leaf in flat:
+        header, body = _leaf_bytes(path, leaf)
+        chunks.append(header)
+        chunks.append(body)
+    return _sha256_chunks(chunks, use_native)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,6 +131,12 @@ class Ledger:
             payload_bytes = int(
                 sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree))
             )
+        return self.append_digest(round_idx, client, digest, payload_bytes)
+
+    def append_digest(self, round_idx: int, client: int, digest: bytes,
+                      payload_bytes: int) -> LedgerEntry:
+        """Chain an entry for an already-computed 32-byte digest (the
+        device-side fingerprint path — the tree never reaches the host)."""
         entry = LedgerEntry(round_idx, client, digest, payload_bytes)
         self.heads.append(self._extend(self.head, entry.serialize()))
         self.entries.append(entry)
@@ -152,7 +166,12 @@ class Ledger:
     def authenticate(self, round_idx: int, client: int, tree) -> bool:
         """Does ``tree`` match what ``client`` committed for ``round_idx``?
         The engine masks out clients whose shipped update fails this check."""
-        digest = params_digest(tree, self.use_native)
+        return self.authenticate_digest(
+            round_idx, client, params_digest(tree, self.use_native))
+
+    def authenticate_digest(self, round_idx: int, client: int,
+                            digest: bytes) -> bool:
+        """Digest-level authenticate (fingerprint path twin)."""
         for e in reversed(self.entries):
             if e.round == round_idx and e.client == client:
                 return e.params_digest == digest

@@ -101,3 +101,17 @@ def lora_targets(name: str):
 
         return LORA_TARGETS
     return lora.DEFAULT_TARGETS
+
+
+def tp_param_specs(name: str, params, axis: str = "tp"):
+    """Megatron tensor-parallel PartitionSpecs for the named model's param
+    tree, dispatched by family (llama vs encoder). Unknown names fall back to
+    the encoder layout — the HF-import path builds encoder classifiers from
+    checkpoint names that are not registry keys."""
+    if name not in _CONFIGS and name in _llama_configs():
+        from bcfl_tpu.models.llama import tp_specs
+
+        return tp_specs(params, axis=axis)
+    from bcfl_tpu.models.bert import tp_specs
+
+    return tp_specs(params, axis=axis)

@@ -169,3 +169,40 @@ class TextClassifier(nn.Module):
         logits = nn.Dense(c.num_labels, dtype=jnp.float32, param_dtype=c.param_dtype,
                           name="classifier")(pooled)
         return logits
+
+
+def tp_specs(params, axis: str = "tp"):
+    """PartitionSpecs for megatron-style tensor parallelism of the encoder
+    family over ``axis``: column-parallel query/key/value (shard heads) and
+    mlp_in (shard the intermediate dim), row-parallel out/mlp_out (shard the
+    input side), everything else — embeddings, norms, pooler, classifier —
+    replicated. Column-parallel biases shard with their outputs; row-parallel
+    biases are replicated (added after the tp all-reduce).
+
+    ``tp`` must divide ``num_heads`` and ``intermediate_size``. The twin of
+    :func:`bcfl_tpu.models.llama.tp_specs` for the BERT/ALBERT family, so a
+    clients x tp mesh works for every registry model.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    COL = {"query", "key", "value", "mlp_in"}
+    ROW = {"out", "mlp_out"}
+
+    def spec(path, leaf):
+        names = tuple(getattr(p, "key", str(p)) for p in path)
+        mod = names[-2] if len(names) >= 2 else ""
+        is_bias = names[-1] == "bias"
+        if mod in COL:
+            if is_bias:  # q/k/v bias [heads, head_dim]; mlp_in bias [ffn]
+                return P(axis) if leaf.ndim == 1 else P(axis, None)
+            # q/k/v kernel [hidden, heads, head_dim] -> shard heads;
+            # mlp_in kernel [hidden, ffn] -> shard ffn
+            return P(None, axis) if leaf.ndim == 2 else P(None, axis, None)
+        if mod in ROW and not is_bias:
+            # out kernel [heads, head_dim, hidden] -> shard heads (input
+            # side); mlp_out kernel [ffn, hidden] -> shard ffn (input side)
+            return P(axis, None) if leaf.ndim == 2 else P(axis, None, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

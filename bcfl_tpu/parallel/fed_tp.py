@@ -1,76 +1,106 @@
-"""Federated LoRA rounds over a 2-D ``clients x tp`` mesh.
+"""Federated rounds over a 2-D ``clients x tp`` mesh.
 
 The 1-D programs in :mod:`bcfl_tpu.fed.client_step` give every client one
 device (or a stacked share of one). For models too large for a single chip —
 the BASELINE.json Llama LoRA config — each client instead spans ``tp`` chips:
 
 - the frozen base params carry megatron tensor-parallel shardings
-  (:func:`bcfl_tpu.models.llama.tp_specs`) over the ``tp`` axis and are
+  (:func:`bcfl_tpu.models.tp_param_specs`) over the ``tp`` axis and are
   shared by every client (replicated over ``clients``),
 - the per-client LoRA adapter stacks carry a leading client dim sharded over
   ``clients`` (adapters are small; they stay replicated over ``tp``),
 - batches are sharded over ``clients`` like the 1-D path.
 
-The whole round is ONE ``jit`` with GSPMD in/out shardings — XLA inserts the
-tp collectives inside each client's forward/backward and the cross-client
-all-reduce for the FedAvg mean. This is the TPU-native composition of the
-reference's two axes of scale (many clients x a big model), neither of which
-the reference itself has (single process, encoder-size models — SURVEY.md
-§2.4-2.5).
+Under GSPMD this composition needs NO separate round implementation: the 1-D
+program bodies run unchanged on the 2-D mesh, and XLA inserts the tp
+collectives inside each client's forward/backward plus the cross-client
+all-reduce from the sharding annotations alone. So this module is a thin
+veneer over :func:`bcfl_tpu.fed.client_step.build_programs` — which means the
+clients x tp path has FULL parity with the 1-D programs (masked weighted
+mean, gossip, split-phase ledger flow, multi-round fusion), not a demo mean.
+The product route is ``FedConfig(tp=...)`` -> :class:`bcfl_tpu.fed.engine.
+FedEngine`; these helpers serve library users composing programs directly.
+
+This is the TPU-native composition of the reference's two axes of scale
+(many clients x a big model), neither of which the reference itself has
+(single process, encoder-size models — SURVEY.md §2.4-2.5).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bcfl_tpu.core.mesh import CLIENT_AXIS
+from bcfl_tpu.core.mesh import CLIENT_AXIS, ClientMesh
 
 Tree = Any
+
+
+def as_client_mesh(mesh: Mesh, num_clients: Optional[int] = None) -> ClientMesh:
+    """Wrap a raw 2-D ``(clients, tp)`` Mesh (e.g. from
+    :func:`bcfl_tpu.core.mesh.fed_tp_mesh`) as a :class:`ClientMesh`."""
+    shards = mesh.shape[CLIENT_AXIS]
+    num_clients = shards if num_clients is None else num_clients
+    if num_clients % shards:
+        raise ValueError(
+            f"num_clients {num_clients} must be a multiple of the mesh's "
+            f"{shards} client shards")
+    return ClientMesh(mesh=mesh, num_clients=num_clients,
+                      per_device=num_clients // shards,
+                      tp=mesh.shape.get("tp", 1))
+
+
+def build_fed_tp_programs(model, mesh: Mesh, num_clients: Optional[int] = None,
+                          **kw):
+    """Full :class:`~bcfl_tpu.fed.client_step.FedPrograms` set on a
+    clients x tp mesh — every 1-D program (server/gossip rounds, fused
+    multi-round variants, split-phase ledger flow, eval) at parity.
+    ``kw`` forwards to :func:`~bcfl_tpu.fed.client_step.build_programs`."""
+    from bcfl_tpu.fed.client_step import build_programs
+
+    return build_programs(model, as_client_mesh(mesh, num_clients),
+                          impl="gspmd", **kw)
 
 
 def build_fed_tp_round(
     model,
     mesh: Mesh,
-    frozen_specs: Tree,
+    frozen_specs: Optional[Tree] = None,
     optimizer: str = "adamw",
     learning_rate: float = 5e-5,
 ) -> Callable:
-    """Compile the clients x tp federated round.
+    """Compile ONE clients x tp federated round (compat shim over
+    :func:`build_fed_tp_programs`).
 
-    ``frozen_specs``: PartitionSpec tree for the frozen base params (e.g.
-    ``tp_specs(frozen)``). Returns ``round_fn(stacked_adapters, frozen,
-    batches, rngs) -> (stacked_adapters, stats [C, 3])`` where the returned
-    adapters are the FedAvg mean re-broadcast to every client (all clients
-    start the next round from consensus, matching the 1-D server path).
+    Returns ``round_fn(stacked_adapters, frozen, batches, rngs, mask=None)
+    -> (stacked_adapters, stats [C, 3])``: each client trains from its own
+    adapters, then every participating client adopts the mask-weighted mean
+    (all-ones default reproduces the FedAvg consensus — all clients start the
+    next round from the average), masked clients keep their own state.
+
+    ``frozen_specs``, when given, is applied to the frozen tree on each call
+    (``device_put`` — a no-op for an already tp-sharded committed tree),
+    preserving the old contract that a host-resident base gets megatron-
+    sharded rather than silently replicated onto every device.
     """
-    # deferred: fed.client_step itself imports bcfl_tpu.parallel (collectives)
-    from bcfl_tpu.fed.client_step import (
-        make_local_train, make_loss_fn, make_optimizer)
+    progs = build_fed_tp_programs(
+        model, mesh, optimizer=optimizer, learning_rate=learning_rate,
+        gossip_steps=0)
+    C = mesh.shape[CLIENT_AXIS]
+    frozen_sh = (None if frozen_specs is None else jax.tree.map(
+        lambda s: NamedSharding(mesh, s), frozen_specs))
 
-    tx = make_optimizer(optimizer, learning_rate)
-    local_train = make_local_train(tx, make_loss_fn(model))
+    def round_fn(stacked, frozen, batches, rngs, mask=None):
+        if mask is None:
+            mask = jnp.ones((C,), jnp.float32)
+        if frozen_sh is not None:
+            frozen = jax.device_put(frozen, frozen_sh)
+        return progs.gossip_round(stacked, frozen, batches, mask, rngs)
 
-    def round_fn(stacked, frozen, batches, rngs):
-        def per_client(ad, b, r):
-            return local_train(ad, frozen, b, jax.random.wrap_key_data(r))
-
-        new, stats = jax.vmap(per_client)(stacked, batches, rngs)
-        avg = jax.tree.map(lambda x: x.mean(axis=0), new)
-        new_stacked = jax.tree.map(
-            lambda a, x: jnp.broadcast_to(a[None], x.shape), avg, new)
-        return new_stacked, stats
-
-    cl = NamedSharding(mesh, P(CLIENT_AXIS))
-    frozen_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), frozen_specs)
-    return jax.jit(
-        round_fn,
-        in_shardings=(cl, frozen_sh, cl, cl),
-        out_shardings=(cl, cl),
-    )
+    return round_fn
 
 
 def stack_adapters(mesh: Mesh, adapters: Tree, num_clients: int) -> Tree:

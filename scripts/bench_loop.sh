@@ -1,0 +1,76 @@
+#!/bin/bash
+# Persistent TPU evidence loop for the wedge-prone axon tunnel.
+#
+# The tunnel's backend init can hang for hours and then recover in a fresh
+# process (PERF.md "measurement hygiene"); a fixed 3x300s retry schedule lost
+# round 3's evidence. This loop instead probes cheaply every PERIOD seconds
+# and fires the heavy jobs only in a healthy window, in stages:
+#
+#   A. headline GSPMD bench (bench.py)        -> results/bench_r04_green.json
+#   B. serverless-mode bench                  -> results/bench_r04_serverless.json
+#   C. tpu_perf.py kernel + dispatch sweep    -> PERF.md (+ marker file)
+#
+# Each stage is skipped once its artifact exists, so the loop is resumable.
+# All child invocations use `timeout -k` (a wedged init ignores SIGTERM).
+set -u
+cd /root/repo
+LOG=results/bench_r04_attempts.log
+PERIOD=${BENCH_LOOP_PERIOD:-900}
+
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+probe() {
+  timeout -k 10 240 python -c "
+import jax
+d = jax.devices()
+assert d[0].platform == 'tpu', d
+print(d[0].device_kind)
+" >> "$LOG" 2>&1
+}
+
+run_bench() {  # $1 = mode, $2 = out file
+  BCFL_BENCH_RETRIES=0 BCFL_BENCH_MODE="$1" \
+    timeout -k 10 7200 python bench.py > /tmp/bench_out_$1.txt 2>> "$LOG"
+  cat /tmp/bench_out_$1.txt >> "$LOG"
+  local line
+  line=$(grep '^{' /tmp/bench_out_$1.txt | tail -1)
+  if [ -n "$line" ] && ! echo "$line" | grep -q '"error"'; then
+    echo "$line" > "$2"
+    say "GREEN $1 -> $2"
+    return 0
+  fi
+  say "bench $1 attempt failed"
+  return 1
+}
+
+while true; do
+  if [ -f results/bench_r04_green.json ] \
+     && [ -f results/bench_r04_serverless.json ] \
+     && [ -f results/tpu_perf_done ]; then
+    say "all stages done; exiting"
+    exit 0
+  fi
+  say "probe"
+  if probe; then
+    say "probe green"
+    if [ ! -f results/bench_r04_green.json ]; then
+      run_bench server results/bench_r04_green.json || { sleep "$PERIOD"; continue; }
+    fi
+    if [ ! -f results/bench_r04_serverless.json ]; then
+      run_bench serverless results/bench_r04_serverless.json || { sleep "$PERIOD"; continue; }
+    fi
+    if [ ! -f results/tpu_perf_done ]; then
+      say "running tpu_perf sweep"
+      if timeout -k 10 14400 python scripts/tpu_perf.py \
+           >> results/tpu_perf_r04.log 2>&1; then
+        touch results/tpu_perf_done
+        say "tpu_perf done -> PERF.md"
+      else
+        say "tpu_perf failed/timed out"
+      fi
+    fi
+  else
+    say "probe wedged/failed"
+  fi
+  sleep "$PERIOD"
+done

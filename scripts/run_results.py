@@ -62,6 +62,10 @@ def main(argv=None):
     ap.add_argument("--out", default="results")
     ap.add_argument("--configs", nargs="*", default=None,
                     help="subset of config names to run")
+    ap.add_argument("--fresh", action="store_true",
+                    help="start a new summary.json instead of merging into "
+                    "an existing one (merging keeps stale entries from runs "
+                    "with different flags)")
     ap.add_argument("--render-only", action="store_true",
                     help="skip training: re-render RESULTS.md + figures from "
                     "an existing <out>/summary.json (e.g. after patching "
@@ -185,9 +189,9 @@ def main(argv=None):
               flush=True)
 
     # merge into any existing summary so partial runs (--configs subsets)
-    # accumulate instead of clobbering earlier results
+    # accumulate instead of clobbering earlier results (--fresh opts out)
     spath = os.path.join(args.out, "summary.json")
-    if os.path.exists(spath):
+    if not args.fresh and os.path.exists(spath):
         with open(spath) as f:
             merged = json.load(f)
         merged.update(summary)
@@ -211,15 +215,20 @@ def _render(args, summary, accuracy_curves):
 def _capacity_note(summary):
     """Derived (not asserted) model-capacity comparison: emitted only when
     the summary holds >= 2 distinct models AND the largest one actually
-    scores best — stated as the measured fact it is."""
-    by_ds = {}
+    scores best — stated as the measured fact it is. Entries are comparable
+    only within one dataset at EQUAL round/seq_len/hf budgets (a merged
+    summary can hold runs with different flags)."""
+    by_key = {}
     for s in summary.values():
         if (s.get("model_size_gb") and s.get("best_acc") is not None
                 and s.get("model") and s.get("dataset")):
-            by_ds.setdefault(s["dataset"], []).append(
+            key = (s["dataset"], s.get("rounds"), s.get("seq_len"),
+                   s.get("hf_weights"))
+            by_key.setdefault(key, []).append(
                 (s["model_size_gb"], s["best_acc"], s["model"]))
-    # compare within ONE dataset only (cross-task accuracy is meaningless)
-    sized = next((rows for rows in by_ds.values()
+    # compare within ONE (dataset, budget) only (cross-task accuracy is
+    # meaningless; cross-budget capacity claims conflate budget with size)
+    sized = next((rows for rows in by_key.values()
                   if len({m for _, _, m in rows}) > 1), [])
     if not sized:
         return ""

@@ -50,10 +50,13 @@ def main(argv=None):
     ap.add_argument("--iid-samples", type=int, default=128,
                     help="per-client per-round sample budget (constant "
                     "across counts; total budget scales with the count)")
-    ap.add_argument("--threshold", type=float, default=0.15,
-                    help="accuracy whose first crossing is reported; with "
-                    "fresh-init tiny models pick a reachable level, on a "
-                    "pretrained run use the reference's 0.9-of-final")
+    ap.add_argument("--threshold", type=float, default=0.0,
+                    help="accuracy whose first crossing is reported. 0 "
+                    "(default) = RELATIVE mode: threshold is computed after "
+                    "all runs as 0.9 x the SMALLEST count's final accuracy "
+                    "— always reachable by construction and comparable "
+                    "across counts (the r03 study's fixed 0.05 was 2x a "
+                    "0.025 chance rate and measured noise)")
     ap.add_argument("--eval-batches", type=int, default=16)
     ap.add_argument("--platform", default=None)
     ap.add_argument("--out", default="results")
@@ -100,20 +103,32 @@ def main(argv=None):
             "acc_curve": accs,
             "final_acc": accs[-1] if accs else None,
             "best_acc": max(accs) if accs else None,
-            "rounds_to_threshold": first_crossing(accs, args.threshold),
-            "threshold": args.threshold,
             "train_samples_total": samples,
             "wall_minutes": wall / 60.0,
             "samples_per_sec_aggregate": samples / wall,
         }
-        print(f"[{name}] best acc {study[count]['best_acc']}, "
-              f"rounds-to-{args.threshold}: "
-              f"{study[count]['rounds_to_threshold']}", flush=True)
+        print(f"[{name}] best acc {study[count]['best_acc']}", flush=True)
+
+    # threshold: explicit, or (relative mode) 0.9 x the smallest federation's
+    # final accuracy — reachable by construction, so rounds-to-threshold is
+    # defined for the anchor run and comparable across counts
+    threshold = args.threshold
+    rel = threshold <= 0.0
+    if rel:
+        anchor = min(study)
+        threshold = round(0.9 * (study[anchor]["final_acc"] or 0.0), 4)
+    for c, s in study.items():
+        s["threshold"] = threshold
+        s["rounds_to_threshold"] = first_crossing(s["acc_curve"], threshold)
+        print(f"[scale_{c}c] rounds-to-{threshold}: "
+              f"{s['rounds_to_threshold']}", flush=True)
 
     meta = {"model": args.model, "dataset": args.dataset,
             "num_labels": args.num_labels,
             "seq_len": args.seq_len, "iid_samples": args.iid_samples,
-            "rounds": args.rounds, "threshold": args.threshold,
+            "rounds": args.rounds, "threshold": threshold,
+            "threshold_mode": ("0.9x smallest-count final" if rel
+                               else "explicit"),
             "counts": args.counts}
     with open(os.path.join(args.out, "scaling.json"), "w") as f:
         json.dump({"meta": meta, "runs": study}, f, indent=2)
@@ -147,9 +162,10 @@ def _write_md(meta, study):
         + (f" = {meta['threshold'] * meta['num_labels']:.1f}x the "
            f"1/{meta['num_labels']} chance rate"
            if meta.get("num_labels") else "")
-        + ": chosen reachable for the run's model/budget (fresh-init "
-        "offline models sit far below pretrained accuracy; on a "
-        "pretrained-weights host use 0.9-of-final instead).",
+        + f" ({meta.get('threshold_mode', 'explicit')}): reachable by "
+        "construction for the smallest federation, so rounds-to-threshold "
+        "is a defined, comparable quantity — not the r03 study's "
+        "noise-level fixed cutoff.",
         "",
         f"| clients | best acc | final acc | rounds to {meta['threshold']} "
         "| total train samples | wall min |",
@@ -165,6 +181,27 @@ def _write_md(meta, study):
             f"{fmt(s['final_acc'], '.3f')} | "
             f"{rt if rt is not None else 'not reached'} | "
             f"{s['train_samples_total']} | {fmt(s['wall_minutes'], '.1f')} |")
+    # derive the trend sentence, never assert it: emit only when the data
+    # actually orders (more clients x more total data => fewer-or-equal
+    # rounds to the shared threshold, strictly fewer at the extremes)
+    cs = sorted(study)
+    rts = [study[c]["rounds_to_threshold"] for c in cs]
+    if (len(cs) >= 2 and all(r is not None for r in rts)
+            and all(a >= b for a, b in zip(rts, rts[1:])) and rts[0] > rts[-1]):
+        lines += [
+            f"Measured trend: rounds-to-threshold falls monotonically "
+            f"{rts[0]} -> {rts[-1]} as the federation grows "
+            f"{cs[0]} -> {cs[-1]} clients at a constant per-client budget — "
+            "larger federations see proportionally more data per round and "
+            "converge in fewer rounds.",
+            "",
+        ]
+    elif any(r is None for r in rts):
+        lines += [
+            "Note: some counts did not reach the threshold within the "
+            "round budget; no scaling claim is made for them.",
+            "",
+        ]
     counts = " ".join(str(c) for c in meta.get("counts", []))
     lines += [
         "",

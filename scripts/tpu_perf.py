@@ -45,7 +45,10 @@ def bench_sweep(trace_dir=None, quick=False):
     for rounds, steps in shapes:
         env = dict(os.environ,
                    BCFL_BENCH_ROUNDS=str(rounds), BCFL_BENCH_STEPS=str(steps),
-                   BCFL_BENCH_ITERS="2")
+                   BCFL_BENCH_ITERS="2",
+                   # the sweep is its own retry policy: one wedged shape must
+                   # cost one watchdog window, not 3x + 600s of sleeps
+                   BCFL_BENCH_RETRIES="0")
         # a stale BCFL_BENCH_TRACE from the caller's env would make EVERY
         # shape trace (overhead skews the rows); only the headline one traces
         env.pop("BCFL_BENCH_TRACE", None)

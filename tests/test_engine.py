@@ -333,3 +333,62 @@ def test_serverless_rounds_per_dispatch_matches_per_round_path():
             assert ra.round == rb.round
             np.testing.assert_allclose(ra.train_loss, rb.train_loss,
                                        rtol=1e-4)
+
+
+def test_ledger_fingerprint_path_no_full_transfer(monkeypatch):
+    """Without a tamper hook the ledger must use device-side fingerprints:
+    jax.device_get of the full stacked tree is the r03 bottleneck this
+    replaces (VERDICT r03 weak #4). Chain still valid, auth all-pass, and
+    the run records a 'ledger' StepClock phase."""
+    import jax
+
+    import bcfl_tpu.fed.engine as engine_mod
+
+    calls = []
+    real_device_get = jax.device_get
+
+    def spying_get(x):
+        calls.append(sum(np.asarray(l).nbytes
+                         for l in jax.tree.leaves(real_device_get(x))))
+        return real_device_get(x)
+
+    cfg = _cfg(mode="server", ledger=LedgerConfig(enabled=True))
+    eng = FedEngine(cfg)
+    monkeypatch.setattr(engine_mod.jax, "device_get", spying_get)
+    res = eng.run()
+    # checkpointing is off, so nothing should have pulled a full param tree
+    assert not calls, f"full-tree device_get in ledger path: {calls}"
+    assert res.ledger.verify_chain() == -1
+    assert all(r.auth == [1.0] * cfg.num_clients for r in res.metrics.rounds)
+    assert res.metrics.phases["ledger"]["count"] > 0
+    assert res.metrics.ledger["reduction"] > 0.99
+
+
+def test_ledger_fused_rounds_match_per_round():
+    """VERDICT r03 weak #4: the ledger no longer disables round fusion. A
+    fused ledger run must produce the same chain length, all-pass auth, and
+    (numerically close) final params as the per-round ledger run."""
+    import jax
+
+    cfg = _cfg(mode="server", num_rounds=4,
+               ledger=LedgerConfig(enabled=True))
+    res_per = FedEngine(cfg).run()
+    res_fused = FedEngine(cfg.replace(rounds_per_dispatch=2,
+                                      eval_every=2)).run()
+    assert len(res_fused.metrics.rounds) == 4
+    C = cfg.num_clients
+    assert len(res_fused.ledger) == 4 * C == len(res_per.ledger)
+    assert res_fused.ledger.verify_chain() == -1
+    assert all(r.auth == [1.0] * C for r in res_fused.metrics.rounds)
+    for a, b in zip(jax.tree.leaves(jax.device_get(res_per.trainable)),
+                    jax.tree.leaves(jax.device_get(res_fused.trainable))):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_ledger_fused_serverless_gossip():
+    cfg = _cfg(mode="serverless", num_rounds=2, rounds_per_dispatch=2,
+               eval_every=2, ledger=LedgerConfig(enabled=True))
+    res = FedEngine(cfg).run()
+    assert len(res.ledger) == 2 * cfg.num_clients
+    assert res.ledger.verify_chain() == -1
+    assert res.metrics.ledger["chain_ok"] == 1.0

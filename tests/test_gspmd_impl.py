@@ -58,9 +58,13 @@ def test_server_round_all_masked_keeps_start():
 
 
 @pytest.mark.parametrize("gossip_steps", [0, 1])
-def test_gossip_round_parity(gossip_steps):
+@pytest.mark.parametrize("num_clients", [8, 10])
+def test_gossip_round_parity(gossip_steps, num_clients):
+    # 10-on-5 covers the stacked per_device=2 layout: a ring-order divergence
+    # between gspmd.ring_shift (global roll) and collectives.ring_shift
+    # (local roll + boundary ppermute) would silently change gossip topology
     mesh, sm, gs, params, batches, weights, rngs = _setup(
-        8, gossip_steps=gossip_steps)
+        num_clients, gossip_steps=gossip_steps)
     # mask one client out: exercises the freeze + neighbor-mask paths
     mask = weights.at[3].set(0.0)
     stacked = sm.broadcast(params)
@@ -70,11 +74,12 @@ def test_gossip_round_parity(gossip_steps):
     assert _max_diff(s1, s2) < 1e-3
 
 
-def test_gossip_rounds_parity():
+@pytest.mark.parametrize("num_clients", [8, 10])
+def test_gossip_rounds_parity(num_clients):
     """The fused multi-round gossip program (R rounds scanned on-device)
     agrees across impls and with R sequential gossip_round calls."""
     R = 2
-    mesh, sm, gs, params, batches, weights, rngs = _setup(8)
+    mesh, sm, gs, params, batches, weights, rngs = _setup(num_clients)
     mask = weights.at[3].set(0.0)
     rb = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (R,) + x.shape), batches)

@@ -70,3 +70,40 @@ def test_num_labels_mismatch_hard_errors():
         import_state_dict(m.state_dict(), cfg)
     tree = import_state_dict(m.state_dict(), cfg, reinit_classifier=True)
     assert tree["params"]["classifier"]["kernel"].shape == (16, 41)
+
+
+def test_engine_hf_checkpoint_path_runs_offline(tmp_path):
+    """VERDICT r03 #8: the `run_results.py --hf` code path (FedConfig.
+    hf_checkpoint + HF tokenizer) must not bitrot while the host is
+    zero-egress. from_pretrained accepts a local directory, so a
+    locally-constructed tiny checkpoint exercises the exact import-and-run
+    flow the connected-host `--hf --model biobert-base` order will take."""
+    ckpt = tmp_path / "mock-biobert"
+    hf_cfg = transformers.BertConfig(
+        vocab_size=32, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=40, num_labels=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    transformers.BertForSequenceClassification(hf_cfg).save_pretrained(ckpt)
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "a", "good", "bad", "movie", "##s", "was", "is", "not"]
+    (tmp_path / "vocab.txt").write_text("\n".join(vocab))
+    transformers.BertTokenizerFast(
+        str(tmp_path / "vocab.txt"), do_lower_case=True).save_pretrained(ckpt)
+
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.fed.engine import FedEngine
+
+    cfg = FedConfig(
+        dataset="synthetic", num_labels=2, seq_len=16, batch_size=4,
+        model="biobert-base",  # registry name is irrelevant once hf wins
+        hf_checkpoint=str(ckpt), tokenizer=str(ckpt),
+        num_clients=2, num_rounds=1, max_local_batches=2,
+        partition=PartitionConfig(kind="iid", iid_samples=8))
+    eng = FedEngine(cfg)
+    # imported config, not the registry one: hidden_size from the checkpoint
+    assert eng.model.cfg.hidden_size == 16
+    assert eng.tokenizer.vocab_size == len(vocab)
+    res = eng.run()
+    assert len(res.metrics.rounds) == 1
+    assert np.isfinite(res.metrics.rounds[0].train_loss)

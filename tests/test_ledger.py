@@ -95,3 +95,58 @@ def test_jax_arrays_digest_like_numpy():
     t_np = _tree(3)
     t_jax = {k: {k2: jnp.asarray(v2) for k2, v2 in v.items()} for k, v in t_np.items()}
     assert params_digest(t_np) == params_digest(t_jax)
+
+
+# ---------------------------- device-side fingerprints ----------------------
+
+def test_client_fingerprint_sensitive_and_deterministic():
+    import jax
+
+    from bcfl_tpu.ledger import client_fingerprint, tree_fingerprint
+
+    t = {k: {k2: jnp.asarray(np.stack([v2, v2 + 1.0]))  # C=2 stacked
+             for k2, v2 in v.items()} for k, v in _tree(0).items()}
+    fp = np.asarray(client_fingerprint(t))
+    assert fp.shape[0] == 2 and fp.shape[1] >= 4
+    # deterministic across calls
+    np.testing.assert_array_equal(fp, np.asarray(client_fingerprint(t)))
+    # one element change moves that client's fingerprint (and only that one)
+    t2 = jax.tree.map(lambda x: np.array(x, copy=True), t)
+    jax.tree.leaves(t2)[0][1][0] += 1e-3
+    fp2 = np.asarray(client_fingerprint(jax.tree.map(jnp.asarray, t2)))
+    np.testing.assert_array_equal(fp[0], fp2[0])
+    assert not np.array_equal(fp[1], fp2[1])
+    # the unstacked fingerprint matches the stacked row
+    one = np.asarray(tree_fingerprint(
+        jax.tree.map(lambda x: jnp.asarray(x[0]), t)))
+    np.testing.assert_allclose(one, fp[0], rtol=1e-6)
+
+
+def test_struct_and_entry_digest():
+    from bcfl_tpu.ledger import entry_digest, struct_digest
+
+    t = _tree(0)
+    s = struct_digest(t)
+    assert struct_digest(_tree(1)) == s  # data-independent
+    t2 = {"renamed": t["layer"], "head": t["head"]}
+    assert struct_digest(t2) != s  # name-sensitive
+    fp = np.arange(4).astype(np.float32)
+    d1 = entry_digest(s, fp)
+    assert len(d1) == 32
+    assert entry_digest(s, fp) == d1
+    assert entry_digest(s, fp + 1e-6) != d1
+    assert entry_digest(struct_digest(t2), fp) != d1
+    # native and hashlib agree
+    assert struct_digest(t, use_native=False) == struct_digest(t, True)
+    assert entry_digest(s, fp, use_native=False) == entry_digest(s, fp, True)
+
+
+def test_append_digest_and_authenticate_digest():
+    led = Ledger()
+    d = hashlib.sha256(b"update").digest()
+    led.append_digest(0, 1, d, payload_bytes=1000)
+    assert led.verify_chain() == -1
+    assert led.authenticate_digest(0, 1, d)
+    assert not led.authenticate_digest(0, 1, hashlib.sha256(b"x").digest())
+    assert not led.authenticate_digest(0, 2, d)
+    assert led.entries[0].payload_bytes == 1000

@@ -106,6 +106,13 @@ class FedConfig:
     vocab_size: int = 8192  # hash-tokenizer vocab (HF tokenizers override this)
     tokenizer: str = "hash"  # "hash" | HF tokenizer name
 
+    # --- task ---
+    # "classification" = the reference's task (sequence classification);
+    # "causal_lm" = federated next-token fine-tuning on the client corpora
+    # (llama family only — the capability the BASELINE.json Llama-LoRA
+    # config exists for; labels columns are ignored, ids are the targets)
+    task: str = "classification"
+
     # --- model ---
     model: str = "tiny-bert"  # key into bcfl_tpu.models registry
     hf_checkpoint: Optional[str] = None  # e.g. "albert-base-v2" to import weights
@@ -181,6 +188,8 @@ class FedConfig:
             raise ValueError(f"unknown sync: {self.sync!r}")
         if self.num_clients < 1 or self.num_rounds < 1:
             raise ValueError("num_clients and num_rounds must be >= 1")
+        if self.task not in ("classification", "causal_lm"):
+            raise ValueError(f"unknown task: {self.task!r}")
         if self.tp < 1:
             raise ValueError(f"tp must be >= 1, got {self.tp}")
         if self.tp > 1 and self.lora_rank <= 0:

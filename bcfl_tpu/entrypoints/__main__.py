@@ -29,6 +29,10 @@ def main(argv=None):
     ap.add_argument("--model", default=None)
     ap.add_argument("--dataset", default=None)
     ap.add_argument("--mode", choices=["server", "serverless"], default=None)
+    ap.add_argument("--task", choices=["classification", "causal_lm"],
+                    default=None,
+                    help="causal_lm = federated next-token fine-tuning "
+                         "(llama-family models; label columns ignored)")
     ap.add_argument("--sync", choices=["sync", "async"], default=None)
     ap.add_argument("--seq-len", type=int, default=None)
     ap.add_argument("--batch-size", type=int, default=None)
@@ -74,7 +78,7 @@ def main(argv=None):
     cfg = get_preset(args.preset, hf=args.hf)
     simple = {
         "clients": "num_clients", "rounds": "num_rounds", "model": "model",
-        "dataset": "dataset", "mode": "mode", "sync": "sync",
+        "dataset": "dataset", "mode": "mode", "sync": "sync", "task": "task",
         "seq_len": "seq_len", "batch_size": "batch_size",
         "lr": "learning_rate", "lora_rank": "lora_rank",
         "max_local_batches": "max_local_batches", "seed": "seed",

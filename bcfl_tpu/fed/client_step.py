@@ -63,14 +63,25 @@ def _merge(trainable: Tree, frozen: Optional[Tree]) -> Tree:
     return lora_lib.apply_lora(frozen, trainable)
 
 
-def make_loss_fn(model) -> Callable:
-    def loss_fn(trainable, frozen, batch, rng):
+def make_loss_fn(model, task: str = "classification") -> Callable:
+    """Per-batch loss + (correct, n) stats, shared by train and eval.
+
+    ``classification``: softmax CE over the label column (reference task).
+    ``causal_lm``: next-token CE — targets are ``ids`` shifted left, token
+    positions weighted by the padding mask x example mask; ``n`` counts
+    TOKENS, so the engine's loss/acc normalization is per-token.
+    """
+
+    def _forward(trainable, frozen, batch, rng):
         params = _merge(trainable, frozen)
-        logits = model.apply(
+        return model.apply(
             {"params": params}, batch["ids"], batch["mask"],
             deterministic=rng is None,
             rngs=None if rng is None else {"dropout": rng},
         )
+
+    def loss_cls(trainable, frozen, batch, rng):
+        logits = _forward(trainable, frozen, batch, rng)
         labels = batch["labels"]
         ex = batch["example_mask"].astype(jnp.float32)
         per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
@@ -79,7 +90,25 @@ def make_loss_fn(model) -> Callable:
         correct = ((jnp.argmax(logits, -1) == labels).astype(jnp.float32) * ex).sum()
         return loss, (correct, ex.sum())
 
-    return loss_fn
+    def loss_lm(trainable, frozen, batch, rng):
+        logits = _forward(trainable, frozen, batch, rng)  # [B, S, V]
+        targets = batch["ids"][:, 1:]
+        logits = logits[:, :-1]
+        w = (batch["mask"][:, 1:].astype(jnp.float32)
+             * batch["example_mask"].astype(jnp.float32)[:, None])
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        n = jnp.maximum(w.sum(), 1.0)
+        loss = (per_tok * w).sum() / n
+        correct = ((jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+                   * w).sum()
+        return loss, (correct, w.sum())
+
+    if task == "classification":
+        return loss_cls
+    if task == "causal_lm":
+        return loss_lm
+    raise ValueError(f"unknown task {task!r}")
 
 
 def _unstack_rng(r):
@@ -200,6 +229,7 @@ def build_programs(
     max_grad_norm: float = 0.0,
     gossip_alpha: float = 0.5,
     gossip_steps: int = 1,
+    task: str = "classification",
     # donate=True deletes the caller's input param/opt buffers after each call
     # (halves peak HBM for the round-chained engine); leave False if you reuse
     # the input tree afterwards.
@@ -221,7 +251,7 @@ def build_programs(
         return _build_programs_gspmd(
             model, mesh, optimizer=optimizer, learning_rate=learning_rate,
             max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
-            gossip_steps=gossip_steps, donate=donate)
+            gossip_steps=gossip_steps, donate=donate, task=task)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
     if getattr(mesh, "tp", 1) > 1:
@@ -231,7 +261,7 @@ def build_programs(
             "clients x tp meshes require impl='gspmd' (unset BCFL_FED_IMPL "
             "or set it to 'gspmd' when tp > 1)")
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
-    loss_fn = make_loss_fn(model)
+    loss_fn = make_loss_fn(model, task)
     axis = mesh.axis
     jmesh = mesh.mesh
     repl = P()
@@ -494,6 +524,7 @@ def _build_programs_gspmd(
     gossip_alpha: float = 0.5,
     gossip_steps: int = 1,
     donate: bool = False,
+    task: str = "classification",
 ) -> FedPrograms:
     """GSPMD twin of the shard_map builder: identical program signatures and
     semantics (global stacked-client arrays in, global arrays out), but the
@@ -501,7 +532,7 @@ def _build_programs_gspmd(
     annotations — reductions/rolls over the sharded client dim become XLA
     all-reduce / collective-permute (:mod:`bcfl_tpu.parallel.gspmd`)."""
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
-    loss_fn = make_loss_fn(model)
+    loss_fn = make_loss_fn(model, task)
     local_train = make_local_train(tx, loss_fn)
     jmesh = mesh.mesh
     cl = NamedSharding(jmesh, P(mesh.axis))

@@ -110,6 +110,10 @@ class FedEngine:
 
         # --- model ---
         if cfg.hf_checkpoint is not None:
+            if cfg.task == "causal_lm":
+                raise ValueError(
+                    "task='causal_lm' needs a decoder; the HF import path "
+                    "builds encoder classifiers")
             from bcfl_tpu.models.hf_import import import_pretrained
 
             model_cfg, variables = import_pretrained(
@@ -124,6 +128,7 @@ class FedEngine:
             self.model = build_model(
                 cfg.model, num_labels=self.num_labels,
                 vocab_size=self.tokenizer.vocab_size,
+                head="lm" if cfg.task == "causal_lm" else "classifier",
             )
             ids = jnp.ones((2, cfg.seq_len), jnp.int32)
             params = self.model.init(
@@ -149,15 +154,12 @@ class FedEngine:
         if cfg.tp > 1:
             from jax.sharding import NamedSharding
 
-            # dispatch on the BUILT model's family, not cfg.model: an
+            from bcfl_tpu.models import tp_param_specs
+
+            # tp_param_specs dispatches on the BUILT model's family (an
             # hf_checkpoint always builds an encoder, even when cfg.model
-            # names a llama config — name-based specs would silently
-            # replicate the base onto every tp shard
-            if isinstance(self.model, TextClassifier):
-                from bcfl_tpu.models.bert import tp_specs
-            else:
-                from bcfl_tpu.models.llama import tp_specs
-            specs = tp_specs(self.frozen)
+            # names a llama config)
+            specs = tp_param_specs(self.model, self.frozen)
             if not any("tp" in str(s) for s in jax.tree.leaves(specs)):
                 raise ValueError(
                     "tp > 1 but no parameter matched the tensor-parallel "
@@ -172,6 +174,7 @@ class FedEngine:
             max_grad_norm=cfg.max_grad_norm,
             gossip_alpha=cfg.topology.gossip_alpha,
             gossip_steps=cfg.topology.gossip_steps,
+            task=cfg.task,
         )
 
         # --- topology graph ---
@@ -271,6 +274,23 @@ class FedEngine:
         return fp_lib.entry_digest(struct, fp_row,
                                    self.cfg.ledger.use_native)
 
+    def _ledger_commit_rows(self, rnd: int, kind: str, fps) -> None:
+        """Chain one entry per client for the given fingerprint rows [C, K]."""
+        for c in range(self.cfg.num_clients):
+            self.ledger.append_digest(
+                rnd, c, self._entry_digest(kind, fps[c]),
+                self._client_payload_bytes)
+
+    def _ledger_auth_rows(self, rnd: int, kind: str, fps) -> np.ndarray:
+        """0/1 auth mask: do the fingerprint rows match the committed chain
+        entries for this round? Shared by the split-phase, fused, and
+        faithful ledger paths so the digest binding cannot diverge."""
+        return np.asarray([
+            1.0 if self.ledger.authenticate_digest(
+                rnd, c, self._entry_digest(kind, fps[c]))
+            else 0.0
+            for c in range(self.cfg.num_clients)], np.float32)
+
     def _ledger_verify(self, rnd: int, stacked) -> np.ndarray:
         """Commit every client's update, then authenticate. Returns auth mask.
 
@@ -295,20 +315,13 @@ class FedEngine:
                                        jax.tree.map(lambda x: x[c], host))
                 return self._ledger_authenticate(rnd, host)
             fp = np.asarray(self.progs.fingerprint(stacked))
-            for c in range(C):
-                self.ledger.append_digest(
-                    rnd, c, self._entry_digest("stacked", fp[c]),
-                    self._client_payload_bytes)
+            self._ledger_commit_rows(rnd, "stacked", fp)
             # authenticate what is about to be aggregated by re-deriving each
             # digest from the fingerprint; the device arrays are immutable,
             # so re-running the fingerprint program would reproduce `fp`
             # bit-for-bit — committing and aggregating the same HBM buffer
             # is what makes auth an identity here (no transport in-sim)
-            return np.asarray([
-                1.0 if self.ledger.authenticate_digest(
-                    rnd, c, self._entry_digest("stacked", fp[c]))
-                else 0.0
-                for c in range(C)], np.float32)
+            return self._ledger_auth_rows(rnd, "stacked", fp)
 
     # ------------------------------------------------------------------- run
 
@@ -515,21 +528,13 @@ class FedEngine:
         fingerprints were computed in-graph ([k, C, K]); chain them all
         after the dispatch and stamp the (identity, see ``_chunk_rounds``)
         auth masks on the records."""
-        C = self.cfg.num_clients
         fps = np.asarray(fps)  # blocks on the fused dispatch: round_program
         with self.clock.phase("ledger"):
             for i in range(k):
-                for c in range(C):
-                    self.ledger.append_digest(
-                        rnd + i, c, self._entry_digest("stacked", fps[i, c]),
-                        self._client_payload_bytes)
+                self._ledger_commit_rows(rnd + i, "stacked", fps[i])
             for i, rec in enumerate(recs):
-                rec.auth = [
-                    1.0 if self.ledger.authenticate_digest(
-                        rnd + i, c,
-                        self._entry_digest("stacked", fps[i, c]))
-                    else 0.0
-                    for c in range(C)]
+                rec.auth = self._ledger_auth_rows(
+                    rnd + i, "stacked", fps[i]).tolist()
 
     def _server_chunk(self, rnd: int, trainable, k: int):
         """Run rounds [rnd, rnd+k) in ONE XLA dispatch via server_rounds."""
@@ -715,11 +720,7 @@ class FedEngine:
                 # reuse the commit-time fingerprints: the snapshots are
                 # immutable device buffers, so recomputing would reproduce
                 # them bit-for-bit at 2x the fingerprint cost
-                auth = np.asarray([
-                    1.0 if self.ledger.authenticate_digest(
-                        rnd, c, self._entry_digest("one", snap_fps[c]))
-                    else 0.0
-                    for c in range(cfg.num_clients)], np.float32)
+                auth = self._ledger_auth_rows(rnd, "one", snap_fps)
             rec.auth = auth.tolist()
             w = w * auth
         elif self.ledger is not None:

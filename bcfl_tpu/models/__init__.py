@@ -83,14 +83,21 @@ def list_models():
     return sorted(_CONFIGS) + sorted(_llama_configs())
 
 
-def build(name: str, **overrides):
-    """Build the named classifier; encoder and llama families share the
-    forward signature ``apply(vars, ids, mask, deterministic=...) -> logits``."""
+def build(name: str, head: str = "classifier", **overrides):
+    """Build the named model; encoder and llama families share the forward
+    signature ``apply(vars, ids, mask, deterministic=...) -> logits``.
+    ``head="lm"`` builds the causal-LM variant ([B, S, vocab] logits —
+    llama family only; encoders are bidirectional, so next-token training
+    would leak the target)."""
     cfg = get_config(name, **overrides)
     if name not in _CONFIGS:
-        from bcfl_tpu.models.llama import LlamaClassifier
+        from bcfl_tpu.models.llama import LlamaClassifier, LlamaLM
 
-        return LlamaClassifier(cfg)
+        return LlamaLM(cfg) if head == "lm" else LlamaClassifier(cfg)
+    if head == "lm":
+        raise ValueError(
+            f"model {name!r} is an encoder: causal-LM training needs a "
+            "decoder (llama family)")
     return TextClassifier(cfg)
 
 
@@ -103,15 +110,21 @@ def lora_targets(name: str):
     return lora.DEFAULT_TARGETS
 
 
-def tp_param_specs(name: str, params, axis: str = "tp"):
-    """Megatron tensor-parallel PartitionSpecs for the named model's param
-    tree, dispatched by family (llama vs encoder). Unknown names fall back to
-    the encoder layout — the HF-import path builds encoder classifiers from
-    checkpoint names that are not registry keys."""
-    if name not in _CONFIGS and name in _llama_configs():
-        from bcfl_tpu.models.llama import tp_specs
+def tp_param_specs(model, params, axis: str = "tp"):
+    """Megatron tensor-parallel PartitionSpecs for ``params``, dispatched on
+    the BUILT model's family. Pass the model INSTANCE (what :func:`build`
+    returned), not a registry name: an ``hf_checkpoint`` run always builds an
+    encoder even when the config names a llama model, and name-based specs
+    would then match nothing and silently replicate the base onto every tp
+    shard. This is the single dispatch point (the engine calls it too)."""
+    if isinstance(model, str):
+        raise TypeError(
+            "tp_param_specs takes the built model instance, not a name: "
+            "a name cannot see through hf_checkpoint overrides")
+    if isinstance(model, TextClassifier):
+        from bcfl_tpu.models.bert import tp_specs
 
         return tp_specs(params, axis=axis)
-    from bcfl_tpu.models.bert import tp_specs
+    from bcfl_tpu.models.llama import tp_specs
 
     return tp_specs(params, axis=axis)

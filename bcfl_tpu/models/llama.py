@@ -28,8 +28,12 @@ from jax.sharding import PartitionSpec as P
 
 from bcfl_tpu.ops.attention import dot_product_attention
 
+# lm_head is a LoRA target (not a full-trained head): on llama2-7b it is
+# ~131M params, so full training would defeat the adapter-only
+# communication win; the small classifier head full-trains via
+# bcfl_tpu.models.lora.HEAD_MODULES
 LORA_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
-                "gate_proj", "up_proj", "down_proj")
+                "gate_proj", "up_proj", "down_proj", "lm_head")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -21,6 +21,13 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_TARGETS = ("query", "key", "value", "out", "mlp_in", "mlp_out")
+# task heads are TRAINED IN FULL under LoRA (HF modules_to_save convention):
+# a LoRA-only run would otherwise optimize against a frozen randomly-
+# initialized head and plateau. They are small (hidden x labels / hidden x
+# hidden); the vocab-sized lm_head instead gets a LoRA adapter (llama
+# LORA_TARGETS) — full-training it would be ~131M params/client on
+# llama2-7b, defeating the adapter-only communication win.
+HEAD_MODULES = ("classifier", "pooler")
 
 
 def _is_target(path: Tuple[str, ...], targets: Sequence[str]) -> bool:
@@ -28,14 +35,20 @@ def _is_target(path: Tuple[str, ...], targets: Sequence[str]) -> bool:
 
 
 def init_lora(key: jax.Array, params, rank: int,
-              targets: Sequence[str] = DEFAULT_TARGETS):
+              targets: Sequence[str] = DEFAULT_TARGETS,
+              head_modules: Sequence[str] = HEAD_MODULES):
     """Create the adapter tree: for each targeted kernel W (viewed 2D as
     [fan_in, fan_out]) an ``a`` [fan_in, rank] (gaussian/sqrt(rank)) and
-    ``b`` [rank, fan_out] (zeros — adapters start as identity)."""
+    ``b`` [rank, fan_out] (zeros — adapters start as identity). Leaves of
+    ``head_modules`` are copied into the tree whole and substituted (not
+    low-rank-added) at merge time, so task heads fine-tune in full."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     adapters = {}
     for path, leaf in flat:
         names = tuple(getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+        if len(names) >= 2 and names[-2] in head_modules:
+            adapters["/".join(names)] = {"full": leaf}
+            continue
         if not _is_target(names, targets):
             continue
         shape = leaf.shape
@@ -62,10 +75,16 @@ def init_lora(key: jax.Array, params, rank: int,
 
 def apply_lora(params, adapters, scale: float = 1.0):
     """Return params with ``W + scale * (a @ b)`` merged into each targeted
-    kernel (reshaped back to the kernel's native rank)."""
+    kernel (reshaped back to the kernel's native rank); head leaves stored
+    whole in the adapter tree (``init_lora`` ``head_modules``) substitute
+    the frozen value outright."""
 
     def merge(path, leaf):
         names = tuple(getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+        k_leaf = "/".join(names)
+        entry = adapters.get(k_leaf)
+        if isinstance(entry, dict) and "full" in entry:
+            return entry["full"].astype(leaf.dtype)
         k = "/".join(names[:-1])
         if names and names[-1] == "kernel" and k in adapters:
             ab = adapters[k]["a"] @ adapters[k]["b"]

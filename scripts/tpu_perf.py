@@ -116,8 +116,13 @@ def attention_sweep(quick=False):
     return f"B={B}, H={H}, D={D}", rows
 
 
+AUTO_BEGIN = "<!-- tpu_perf auto-section begin -->"
+AUTO_END = "<!-- tpu_perf auto-section end -->"
+
+
 def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir):
     lines = [
+        AUTO_BEGIN,
         "# PERF — measured performance evidence",
         "",
         f"Device: **{device}**. Metric derivations:",
@@ -168,10 +173,30 @@ def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir):
     lines += [
         "Reproduce: `python scripts/tpu_perf.py` on the TPU host; "
         "`--platform cpu --quick` for a plumbing check on the CPU mesh.",
+        AUTO_END,
         "",
     ]
-    with open("PERF.md", "w") as f:
-        f.write("\n".join(lines))
+    # replace only the marked auto-section so the hand-written analysis
+    # below it (shard_map bisection, measurement-hygiene notes, CPU-side
+    # ledger/fingerprint measurements) survives unattended sweep runs
+    block = "\n".join(lines)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PERF.md")
+    try:
+        with open(path) as f:
+            existing = f.read()
+    except FileNotFoundError:
+        existing = ""
+    if AUTO_BEGIN in existing and AUTO_END in existing:
+        pre = existing.split(AUTO_BEGIN)[0]
+        post = existing.split(AUTO_END, 1)[1]
+        out = pre + block + post
+    elif existing:
+        out = block + "\n\n---\n\n" + existing
+    else:
+        out = block
+    with open(path, "w") as f:
+        f.write(out)
 
 
 def main(argv=None):

@@ -117,6 +117,9 @@ def test_lora_engine_run():
     n_train = sum(x.size for x in jax.tree.leaves(res.trainable))
     n_full = sum(x.size for x in jax.tree.leaves(res.params))
     assert n_train < n_full / 5
+    # the task head trains IN FULL under LoRA (a frozen random-init
+    # classifier would cap accuracy); its leaves live in the adapter tree
+    assert any("classifier" in k for k in res.trainable)
 
 
 def test_all_tampered_round_keeps_model():

@@ -69,10 +69,12 @@ def test_lora_on_llama():
     params = _init(model)
     adapters = lora_lib.init_lora(jax.random.key(1), params, rank=4,
                                   targets=LORA_TARGETS)
-    # every decoder layer contributes all 7 target kernels
-    assert len(adapters) == 2 * 7
+    # every decoder layer contributes all 7 projection kernels, plus the
+    # classifier head stored whole (full-trained under LoRA)
+    assert len(adapters) == 2 * 7 + 1
+    assert any("classifier" in k for k in adapters)
     merged = lora_lib.apply_lora(params, adapters)
-    # b=0 init -> merge is identity
+    # b=0 init + untouched head copies -> merge is identity
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(merged)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
@@ -126,3 +128,56 @@ def test_flash_path_matches_dense_path():
     ld = m_dense.apply({"params": params}, ids, mask)
     lf = m_flash.apply({"params": params}, ids, mask)
     np.testing.assert_allclose(np.asarray(ld), np.asarray(lf), atol=2e-2)
+
+
+# ----------------------------- federated causal LM --------------------------
+
+def test_engine_causal_lm_learns():
+    """task='causal_lm': federated next-token fine-tuning of the decoder —
+    the capability the repo title promises beyond the reference's
+    classification-only task. Loss must drop over rounds on a repetitive
+    synthetic corpus."""
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.fed.engine import FedEngine
+
+    cfg = FedConfig(
+        task="causal_lm", dataset="synthetic", num_labels=2, seq_len=32,
+        batch_size=8, vocab_size=256, model="tiny-llama", num_clients=4,
+        num_rounds=3, learning_rate=3e-3, max_local_batches=4,
+        partition=PartitionConfig(kind="iid", iid_samples=32))
+    res = FedEngine(cfg).run()
+    losses = [r.train_loss for r in res.metrics.rounds]
+    assert len(losses) == 3
+    assert losses[-1] < losses[0] * 0.9, losses
+    # global eval uses per-token normalization too
+    assert res.metrics.global_accuracies[-1] > 0.0
+
+
+def test_engine_causal_lm_with_tp_lora():
+    """causal_lm composes with clients x tp LoRA on the 2-D mesh — and the
+    adapters can actually move the LM loss (regression: lm_head used to be
+    absent from LORA_TARGETS, so LoRA optimized against a frozen random
+    vocab projection)."""
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.fed.engine import FedEngine
+
+    cfg = FedConfig(
+        task="causal_lm", dataset="synthetic", num_labels=2, seq_len=16,
+        batch_size=8, vocab_size=256, model="tiny-llama", lora_rank=4, tp=2,
+        num_clients=4, num_rounds=3, learning_rate=5e-3, max_local_batches=4,
+        partition=PartitionConfig(kind="iid", iid_samples=32))
+    eng = FedEngine(cfg)
+    # lm_head carries a LoRA adapter (not a frozen random projection)
+    assert any("lm_head" in k for k in eng.trainable0)
+    res = eng.run()
+    losses = [r.train_loss for r in res.metrics.rounds]
+    assert losses[-1] < losses[0], losses
+
+
+def test_causal_lm_rejects_encoders():
+    import pytest
+
+    from bcfl_tpu.models import build
+
+    with pytest.raises(ValueError, match="encoder"):
+        build("tiny-bert", head="lm")

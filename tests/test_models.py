@@ -75,9 +75,10 @@ def test_lora_identity_at_init_then_trains():
     l0 = model.apply(variables, ids, mask)
     l1 = model.apply({"params": merged}, ids, mask)
     np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), atol=1e-6)
-    # perturb b -> output changes
+    # perturb b -> output changes (head entries store the leaf whole)
     for k in adapters:
-        adapters[k]["b"] = adapters[k]["b"] + 0.1
+        if "b" in adapters[k]:
+            adapters[k]["b"] = adapters[k]["b"] + 0.1
     l2 = model.apply({"params": lora.apply_lora(variables["params"], adapters)}, ids, mask)
     assert np.abs(np.asarray(l2) - np.asarray(l0)).max() > 1e-4
     # adapters are much smaller than the base

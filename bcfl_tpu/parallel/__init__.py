@@ -7,6 +7,7 @@ from bcfl_tpu.parallel.collectives import (  # noqa: F401
 from bcfl_tpu.parallel import gspmd  # noqa: F401
 from bcfl_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
+    ring_attention_gspmd,
     ring_attention_sharded,
 )
 from bcfl_tpu.parallel.fed_tp import (  # noqa: F401

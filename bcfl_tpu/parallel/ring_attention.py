@@ -43,6 +43,16 @@ def _block(q, k, v, key_bias, scale, dead):
     return m, l, o
 
 
+def _online_merge(acc, m, l, bm, bl, bo):
+    """One step of the FlashAttention online-softmax recurrence, shared by
+    the shard_map and GSPMD implementations (rank-agnostic: broadcasts over
+    whatever leading dims the block stats carry)."""
+    m_new = jnp.maximum(m, bm)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(bm - m_new)
+    return acc * alpha + bo * beta, m_new, l * alpha + bl * beta
+
+
 def ring_attention(
     q: jnp.ndarray,  # [B, H, Sq_local, D]  (inside shard_map)
     k: jnp.ndarray,  # [B, H, Sk_local, D]
@@ -82,12 +92,8 @@ def ring_attention(
         else:
             dead = jnp.zeros((1, 1, 1, 1), bool)
         bm, bl, bo = _block(qf, kc, vc, bc, scale, dead)
-        m_new = jnp.maximum(m, bm)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(bm - m_new)
-        acc = acc * alpha + bo * beta
-        l = l * alpha + bl * beta
-        return acc, m_new, l, kc, vc, bc
+        acc, m, l = _online_merge(acc, m, l, bm, bl, bo)
+        return acc, m, l, kc, vc, bc
 
     def step(t, carry):
         acc, m, l, kc, vc, bc = _merge(t, carry)
@@ -133,3 +139,85 @@ def ring_attention_sharded(
     bsh = NamedSharding(mesh, bs)
     return fn(jax.device_put(q, sh), jax.device_put(k, sh),
               jax.device_put(v, sh), jax.device_put(key_bias, bsh))
+
+
+def ring_attention_gspmd(
+    q: jnp.ndarray,  # [B, H, S, D] global
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    key_bias: Optional[jnp.ndarray],  # [B, S]
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = False,
+) -> jnp.ndarray:
+    """GSPMD twin of :func:`ring_attention_sharded` — same ring math, no
+    shard_map.
+
+    The global arrays are viewed as ``[B, H, n, S/n, D]`` with the block dim
+    sharded over ``axis_name``. Each hop computes the block-diagonal
+    q-block x k-block product (local on every shard — the einsum never
+    contracts across the sharded dim) and rolls the KV blocks one position
+    along it; XLA lowers the roll on a sharded dim to collective-permute,
+    exactly the manual implementation's ppermute ring. Shipped because
+    shard_map executes ~200x slower than jit-with-annotations on the
+    tunnelled axon platform (PERF.md), which made the manual SP path
+    unusable precisely where it matters; parity is pinned by
+    ``tests/test_ring_attention.py``.
+    """
+    B, H, S, D = q.shape
+    n = mesh.shape[axis_name]
+    if S % n:
+        raise ValueError(f"seq {S} not divisible by {axis_name} size {n}")
+    blk = S // n
+    scale = 1.0 / (D ** 0.5)
+    if key_bias is None:
+        key_bias = jnp.zeros((B, S), jnp.float32)
+
+    bsh = NamedSharding(mesh, P(None, None, axis_name, None, None))
+    kbsh = NamedSharding(mesh, P(None, axis_name, None))
+    _c = lax.with_sharding_constraint
+    qb = _c(q.astype(jnp.float32).reshape(B, H, n, blk, D), bsh)
+    k0 = _c(k.astype(jnp.float32).reshape(B, H, n, blk, D), bsh)
+    v0 = _c(v.astype(jnp.float32).reshape(B, H, n, blk, D), bsh)
+    b0 = _c(key_bias.astype(jnp.float32).reshape(B, n, blk), kbsh)
+
+    q_blk = jnp.arange(n)  # global block id at each block-dim position
+    qpos = q_blk[:, None] * blk + jnp.arange(blk)[None, :]  # [n, blk_q]
+
+    def _merge(t, carry):
+        acc, m, l, kc, vc, bc = carry
+        s = jnp.einsum("bhnqd,bhnkd->bhnqk", qb, kc) * scale
+        s = s + bc[:, None, :, None, :]
+        if causal:
+            # after t rolls, block-dim position r holds global block r - t
+            kpos = ((q_blk - t) % n)[:, None] * blk + jnp.arange(blk)[None, :]
+            dead = (kpos[:, None, :] > qpos[:, :, None])[None, None]
+        else:
+            dead = jnp.zeros((1, 1, 1, 1, 1), bool)
+        s = jnp.where(dead, NEG, s)
+        bm = s.max(-1, keepdims=True)
+        p = jnp.where(dead, 0.0, jnp.exp(s - bm))
+        bl = p.sum(-1, keepdims=True)
+        bo = jnp.einsum("bhnqk,bhnkd->bhnqd", p, vc)
+        acc, m, l = _online_merge(acc, m, l, bm, bl, bo)
+        return acc, m, l, kc, vc, bc
+
+    def hop(t, carry):
+        acc, m, l, kc, vc, bc = _merge(t, carry)
+        # roll on the sharded block dim -> collective-permute (each shard
+        # holds exactly one block)
+        kc = _c(jnp.roll(kc, 1, axis=2), bsh)
+        vc = _c(jnp.roll(vc, 1, axis=2), bsh)
+        bc = _c(jnp.roll(bc, 1, axis=1), kbsh)
+        return acc, m, l, kc, vc, bc
+
+    acc = _c(jnp.zeros((B, H, n, blk, D), jnp.float32), bsh)
+    m0 = jnp.full((B, H, n, blk, 1), NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, n, blk, 1), jnp.float32)
+    # n-1 [merge; rotate] hops, then merge the final position without the
+    # otherwise-discarded n-th rotate (same saving as the manual impl)
+    carry = lax.fori_loop(0, n - 1, hop, (acc, m0, l0, k0, v0, b0))
+    acc, m, l, *_ = _merge(n - 1, carry)
+    out = (acc / jnp.maximum(l, 1e-9)).reshape(B, H, S, D)
+    return _c(out, NamedSharding(mesh, P(None, None, axis_name, None))
+              ).astype(q.dtype)

@@ -436,7 +436,13 @@ class FedEngine:
     def _maybe_eval(self, rnd: int, rec: RoundRecord, trainable, stacked,
                     clock) -> None:
         cfg = self.cfg
-        if not (cfg.eval_every and (rnd + 1) % cfg.eval_every == 0):
+        # the FINAL round always evaluates (when eval is on at all): with
+        # eval_every=N and rounds % N != 0 the run would otherwise end
+        # without a final-round number, and callers report accs[-1] as the
+        # final accuracy
+        due = ((rnd + 1) % cfg.eval_every == 0
+               or rnd == cfg.num_rounds - 1) if cfg.eval_every else False
+        if not due:
             return
         with clock.phase("eval"):
             loss, acc = self._global_eval(trainable)

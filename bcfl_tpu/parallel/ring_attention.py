@@ -186,19 +186,17 @@ def ring_attention_gspmd(
 
     def _merge(t, carry):
         acc, m, l, kc, vc, bc = carry
-        s = jnp.einsum("bhnqd,bhnkd->bhnqk", qb, kc) * scale
-        s = s + bc[:, None, :, None, :]
         if causal:
             # after t rolls, block-dim position r holds global block r - t
             kpos = ((q_blk - t) % n)[:, None] * blk + jnp.arange(blk)[None, :]
             dead = (kpos[:, None, :] > qpos[:, :, None])[None, None]
         else:
-            dead = jnp.zeros((1, 1, 1, 1, 1), bool)
-        s = jnp.where(dead, NEG, s)
-        bm = s.max(-1, keepdims=True)
-        p = jnp.where(dead, 0.0, jnp.exp(s - bm))
-        bl = p.sum(-1, keepdims=True)
-        bo = jnp.einsum("bhnqk,bhnkd->bhnqd", p, vc)
+            dead = jnp.zeros((1, 1, n, 1, 1), bool)
+        # the block-dim einsum never contracts across blocks, so the scoring
+        # math is exactly _block vmapped over the (sharded) block dim — ONE
+        # copy of the numerically delicate flash-block computation
+        bm, bl, bo = jax.vmap(_block, in_axes=(2, 2, 2, 1, None, 2),
+                              out_axes=(2, 2, 2))(qb, kc, vc, bc, scale, dead)
         acc, m, l = _online_merge(acc, m, l, bm, bl, bo)
         return acc, m, l, kc, vc, bc
 

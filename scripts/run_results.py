@@ -57,6 +57,10 @@ def main(argv=None):
     ap.add_argument("--eval-batches", type=int, default=0,
                     help="cap central eval batches per round (0 = full "
                     "3,000-row test split, the reference behaviour)")
+    ap.add_argument("--eval-every", type=int, default=1,
+                    help="evaluate every Nth round (per-round local+central "
+                    "eval dominates wall on slow hosts; curves keep their "
+                    "shape at every-2nd-round cadence)")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--hf", action="store_true")
     ap.add_argument("--out", default="results")
@@ -74,6 +78,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.eval_batches < 0:
         ap.error("--eval-batches must be >= 0")
+    if args.eval_every < 1:
+        ap.error("--eval-every must be >= 1")
     if args.seq_len < 0:
         ap.error("--seq-len must be >= 0")
 
@@ -113,7 +119,7 @@ def main(argv=None):
 
 
     common = dict(model=args.model, num_clients=args.clients,
-                  num_rounds=args.rounds,
+                  num_rounds=args.rounds, eval_every=args.eval_every,
                   max_eval_batches=args.eval_batches or None)
     if args.seq_len:
         common["seq_len"] = args.seq_len
@@ -171,11 +177,17 @@ def main(argv=None):
             "rounds": cfg.num_rounds,
             "seq_len": cfg.seq_len,
             "max_eval_batches": cfg.max_eval_batches,
+            "eval_every": cfg.eval_every,
             "dataset": cfg.dataset,
             "platform": platform,
             "final_acc": accs[-1] if accs else None,
             "best_acc": max(accs) if accs else None,
             "acc_curve": accs,
+            # which (1-based) rounds the curve points came from — without
+            # this a merged figure of different eval cadences would plot
+            # incomparable x-indices as if they were the same rounds
+            "acc_rounds": [r.round + 1 for r in m.rounds
+                           if r.global_acc is not None],
             "model_size_gb": m.model_size_gb,
             "wall_minutes": wall / 60.0,
             "info_passing_sync_s": last.info_passing_sync_s,
@@ -203,7 +215,15 @@ def main(argv=None):
 
 
 def _render(args, summary, accuracy_curves):
-    curves = {n: s["acc_curve"] for n, s in summary.items() if s["acc_curve"]}
+    # label each curve with its eval cadence when sparser than every-round,
+    # so a merged figure cannot pass off an every-2nd-round curve as
+    # per-round progress
+    def label(n, s):
+        ee = s.get("eval_every") or 1
+        return f"{n} (eval@{ee})" if ee > 1 else n
+
+    curves = {label(n, s): s["acc_curve"]
+              for n, s in summary.items() if s["acc_curve"]}
     if curves:
         accuracy_curves(
             curves, title="Real-data runs: global accuracy vs round",

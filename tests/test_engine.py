@@ -395,3 +395,11 @@ def test_ledger_fused_serverless_gossip():
     assert len(res.ledger) == 2 * cfg.num_clients
     assert res.ledger.verify_chain() == -1
     assert res.metrics.ledger["chain_ok"] == 1.0
+
+
+def test_final_round_always_evaluated():
+    """eval_every=2 with an odd round count: the run must still end with a
+    final-round evaluation (final_acc is reported as the headline number)."""
+    res = FedEngine(_cfg(mode="server", num_rounds=3, eval_every=2)).run()
+    evald = [r.round for r in res.metrics.rounds if r.global_acc is not None]
+    assert evald == [1, 2]  # the eval_every boundary AND the forced final

@@ -176,6 +176,16 @@ class FedEngine:
             gossip_steps=cfg.topology.gossip_steps,
             task=cfg.task,
         )
+        # Pin the global trees to their steady-state shardings NOW: the round
+        # programs return replicated trees, so a single-device-committed
+        # trainable0 would make round 2's input sharding differ from round
+        # 1's — a full recompile of the round program on the second round
+        # (measured as the r04 bench's 87.5 s/dispatch artifact,
+        # results/dispatch_bisect.json). frozen keeps its tp layout when
+        # tp > 1 (placed above).
+        self.trainable0 = self.mesh.replicate(self.trainable0)
+        if self.frozen is not None and cfg.tp == 1:
+            self.frozen = self.mesh.replicate(self.frozen)
 
         # --- topology graph ---
         if cfg.topology.bandwidth == "reference" and cfg.num_clients == 10:

@@ -143,6 +143,13 @@ def main():
         params = jax.jit(
             lambda k: model.init(k, ids0, ids0)["params"])(jax.random.key(0))
         jax.block_until_ready(params)
+        # place params in the round program's steady-state (replicated)
+        # sharding BEFORE the first call: a single-device-committed input
+        # would compile once for that layout and then AGAIN when the chained
+        # carry comes back with the program's out_shardings — and that second
+        # compile lands inside the timed loop (the r04 87.5 s/dispatch
+        # artifact, results/dispatch_bisect.json)
+        params = jax.device_put(params, mesh.replicated())
         n_params = sum(x.size for x in jax.tree.leaves(params))
         progs = build_programs(model, mesh, donate=True)
 
@@ -175,6 +182,11 @@ def main():
                 c, None, rbatches, rweights, rrngs)[0]
 
         watchdog.stage("compile")
+        # TWO warmups: even with the input pre-placed, any residual
+        # input-sharding/layout drift between call 1 and call 2 (e.g. donated
+        # buffers) must trigger its recompile HERE, not inside the timed loop
+        carry = run_block(carry)
+        jax.block_until_ready(carry)
         carry = run_block(carry)
         jax.block_until_ready(carry)
 

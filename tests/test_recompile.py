@@ -1,0 +1,56 @@
+"""Regression: the engine's round programs must compile exactly once.
+
+The r04 bench recorded 87.5 s/dispatch because the warmup call's input
+params were single-device committed while its output carried the program's
+``out_shardings`` — so the SECOND call was a new jit cache entry (a full
+recompile) that landed inside the timed loop (PERF.md, results/
+dispatch_bisect.json). ``FedEngine.__init__`` now pins ``trainable0`` /
+``frozen`` to their steady-state shardings; this test pins THAT by counting
+jit cache entries after a multi-round run. A second cache entry on any round
+program is this bug come back (on a tunnelled TPU it costs minutes per
+round-2 dispatch).
+"""
+
+import pytest
+
+from bcfl_tpu.config import FedConfig, PartitionConfig
+from bcfl_tpu.fed.engine import FedEngine
+
+
+def _run(mode, **kw):
+    cfg = FedConfig(
+        name=f"recompile_{mode}", model="tiny-bert", dataset="synthetic",
+        mode=mode, num_clients=4, num_rounds=3, seq_len=16, batch_size=4,
+        max_local_batches=2,
+        partition=PartitionConfig(kind="iid", iid_samples=8,
+                                  resample_each_round=True),
+        **kw,
+    )
+    eng = FedEngine(cfg)
+    res = eng.run()
+    assert len(res.metrics.rounds) == 3
+    return eng
+
+
+@pytest.mark.parametrize("mode", ["server", "serverless"])
+def test_round_programs_compile_once(mode):
+    eng = _run(mode)
+    progs = eng.progs
+    # the mode's primary round program MUST have compiled exactly once —
+    # == 1, not <= 1, so the test cannot pass vacuously if a future engine
+    # routes rounds elsewhere (then update this map: it pins the hot path)
+    hot = "server_round" if mode == "server" else "gossip_round"
+    assert getattr(progs, hot)._cache_size() == 1, hot
+    for name in ("server_round", "server_rounds", "server_rounds_static",
+                 "gossip_round", "gossip_rounds", "gossip_rounds_static",
+                 "eval_clients", "eval_clients_global", "eval_global",
+                 "client_updates", "local_updates", "mix_only", "collapse"):
+        size = getattr(progs, name)._cache_size()
+        # uncalled programs are 0; any program the run used must be 1
+        assert size <= 1, f"{name} compiled {size}x across a 3-round run"
+
+
+def test_lora_round_programs_compile_once():
+    eng = _run("server", lora_rank=2)
+    size = eng.progs.server_round._cache_size()
+    assert size <= 1, f"lora server_round compiled {size}x"

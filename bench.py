@@ -120,6 +120,13 @@ def main():
         if os.environ.get("BCFL_BENCH_PLATFORM"):
             jax.config.update("jax_platforms",
                               os.environ["BCFL_BENCH_PLATFORM"])
+        # opt-in PRNG impl (e.g. BCFL_BENCH_PRNG=rbg): dropout RNG is +38%
+        # of step time under threefry (PERF.md); rbg uses the TPU hardware
+        # generator. Deliberately NOT the default — the recorded headline
+        # stays on the product's default stream; set this for a bonus row.
+        prng = os.environ.get("BCFL_BENCH_PRNG")
+        if prng:
+            jax.config.update("jax_default_prng_impl", prng)
         import jax.numpy as jnp
 
         from bcfl_tpu.core.mesh import client_mesh
@@ -215,6 +222,8 @@ def main():
             "steps_per_dispatch": ROUNDS * STEPS,
             "wall_s": round(dt, 2),
         }
+        if prng:
+            out["prng"] = prng
         if peak:
             out["mfu_pct"] = round(100.0 * flops / dt / (peak * n_dev), 2)
         watchdog.cancel()

@@ -60,6 +60,10 @@ def main(argv=None):
     ap.add_argument("--eval-batches", type=int, default=16)
     ap.add_argument("--platform", default=None)
     ap.add_argument("--out", default="results")
+    ap.add_argument("--no-md", action="store_true",
+                    help="write <out>/scaling.json + curves but do NOT "
+                    "rewrite SCALING.md (for fallback runs that must not "
+                    "clobber a better run's table)")
     args = ap.parse_args(argv)
 
     # multi-client CPU meshes on a loaded host abort when a device thread
@@ -136,8 +140,10 @@ def main(argv=None):
         {f"{c} clients": s["acc_curve"] for c, s in study.items()},
         title="Scaling: global accuracy vs round by client count",
         path=os.path.join(args.out, "scaling_curves.png"))
-    _write_md(meta, study)
-    print(f"\nwrote {args.out}/scaling.json and SCALING.md", flush=True)
+    if not args.no_md:
+        _write_md(meta, study)
+    print(f"\nwrote {args.out}/scaling.json"
+          + ("" if args.no_md else " and SCALING.md"), flush=True)
 
 
 def _write_md(meta, study):

@@ -53,4 +53,13 @@ def test_round_programs_compile_once(mode):
 def test_lora_round_programs_compile_once():
     eng = _run("server", lora_rank=2)
     size = eng.progs.server_round._cache_size()
-    assert size <= 1, f"lora server_round compiled {size}x"
+    assert size == 1, f"lora server_round compiled {size}x (0 = not the hot path)"
+
+
+def test_async_round_programs_compile_once():
+    """The buffered-async path chains stacked params through
+    local_updates -> collapse -> broadcast -> select every round; any
+    sharding drift in that chain recompiles local_updates round over round."""
+    eng = _run("serverless", sync="async", async_buffer=2)
+    assert eng.progs.local_updates._cache_size() == 1
+    assert eng.progs.collapse._cache_size() <= 1

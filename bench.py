@@ -35,16 +35,21 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 BASELINE_SAMPLES_PER_SEC = 20_000 / 2_400.0  # 8.33, see docstring
 
 BATCH = 32  # reference batch size (server_IID_IMDB.py:96-99)
 SEQ = 128
-ROUNDS = int(os.environ.get("BCFL_BENCH_ROUNDS", "8"))  # fed rounds / dispatch
+ROUNDS = int(os.environ.get("BCFL_BENCH_ROUNDS", "32"))  # fed rounds / dispatch
 STEPS = int(os.environ.get("BCFL_BENCH_STEPS", "8"))  # local batches / round
 ITERS = int(os.environ.get("BCFL_BENCH_ITERS", "2"))  # timed dispatches
 MODE = os.environ.get("BCFL_BENCH_MODE", "server")  # server | serverless
 STAGE_TIMEOUT_S = 1200.0  # per STAGE, reset on every stage transition
+# backend init gets a SHORT deadline: healthy init is 20-40s, a wedged
+# tunnel hangs forever, and the error JSON must outrun the DRIVER's own
+# process timeout (r03's recording died rc=124 with no line at all)
+INIT_TIMEOUT_S = float(os.environ.get("BCFL_BENCH_INIT_TIMEOUT_S", "300"))
 
 PEAK_FLOPS = {  # bf16 peak matmul throughput per chip
     "TPU v5 lite": 197e12,
@@ -98,19 +103,21 @@ class _Watchdog:
 
     def __init__(self, timeout_s: float):
         self._timeout = timeout_s
+        self._armed = timeout_s
         self._timer = None
         self.name = "start"
 
-    def stage(self, name: str):
+    def stage(self, name: str, timeout_s: Optional[float] = None):
         self.name = name
         self.cancel()
-        self._timer = threading.Timer(self._timeout, self._fire)
+        self._armed = self._timeout if timeout_s is None else timeout_s
+        self._timer = threading.Timer(self._armed, self._fire)
         self._timer.daemon = True
         self._timer.start()
 
     def _fire(self):
         _error_json(self.name,
-                    f"stage made no progress within {self._timeout:.0f}s "
+                    f"stage made no progress within {self._armed:.0f}s "
                     "(wedged TPU tunnel?)")
         os._exit(2)
 
@@ -127,7 +134,7 @@ def main():
         _error_json("config", f"unknown BCFL_BENCH_MODE {MODE!r}; "
                     "expected 'server' or 'serverless'")
         sys.exit(1)
-    watchdog.stage("backend-init")
+    watchdog.stage("backend-init", INIT_TIMEOUT_S)
 
     try:
         import jax
@@ -205,10 +212,13 @@ def main():
             run_block = lambda c: progs.server_rounds(  # noqa: E731
                 c, None, rbatches, rweights, rrngs)[0]
 
-        watchdog.stage("compile")
-        # TWO warmups: even with the input pre-placed, any residual
-        # input-sharding/layout drift between call 1 and call 2 (e.g. donated
-        # buffers) must trigger its recompile HERE, not inside the timed loop
+        # compile + TWO warmup dispatches under one deadline: even with the
+        # input pre-placed, any residual input-sharding/layout drift between
+        # call 1 and call 2 (e.g. donated buffers) must trigger its recompile
+        # HERE, not inside the timed loop. The deadline is sized for the
+        # WORST measured regime (~0.35 s/step x 2 x ROUNDS*STEPS) so a slow-
+        # but-alive run is never killed as "wedged"
+        watchdog.stage("compile", 600.0 + 0.7 * ROUNDS * STEPS)
         carry = run_block(carry)
         jax.block_until_ready(carry)
         carry = run_block(carry)
@@ -261,8 +271,11 @@ def _run_with_retries() -> int:
     """
     import subprocess
 
+    # envelope: 3 attempts x 300s wedged-init watchdog + 2 x 120s sleeps
+    # ~= 19 min worst case — the whole schedule must finish inside the
+    # DRIVER's own (unknown) process timeout or no JSON line survives
     attempts = int(os.environ.get("BCFL_BENCH_RETRIES", "2")) + 1
-    delay = float(os.environ.get("BCFL_BENCH_RETRY_DELAY_S", "300"))
+    delay = float(os.environ.get("BCFL_BENCH_RETRY_DELAY_S", "120"))
     last_line = None
     for i in range(attempts):
         env = dict(os.environ, BCFL_BENCH_CHILD="1")

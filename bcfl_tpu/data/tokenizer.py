@@ -66,10 +66,44 @@ class HashTokenizer:
         return out, mask
 
     def encode_batch(self, texts: Sequence[str], seq_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        native = self._encode_batch_native(texts, seq_len)
+        if native is not None:
+            return native
         ids = np.empty((len(texts), seq_len), dtype=np.int32)
         mask = np.empty((len(texts), seq_len), dtype=np.int32)
         for i, t in enumerate(texts):
             ids[i], mask[i] = self.encode(t, seq_len)
+        return ids, mask
+
+    def _encode_batch_native(self, texts: Sequence[str], seq_len: int):
+        """C++ cache-build hot loop (`native/tokenizer.cc`), bit-for-bit
+        equal to :meth:`encode` (pinned by tests/test_native_tokenizer.py).
+        Unicode lowercasing stays HERE (Python's full case rules); the core
+        gets the lowered UTF-8 bytes. Returns None without a toolchain."""
+        import ctypes
+
+        from bcfl_tpu.native.build import load_tokenizer_lib
+
+        lib = load_tokenizer_lib()
+        if lib is None or seq_len <= 0 or len(texts) == 0:
+            return None
+        try:
+            blobs = [t.lower().encode("utf-8") for t in texts]
+        except UnicodeEncodeError:
+            # lone surrogates (e.g. errors='surrogateescape' reads) can't
+            # cross the UTF-8 boundary; the Python path handles them
+            return None
+        offsets = np.zeros((len(blobs) + 1,), dtype=np.int64)
+        np.cumsum([len(b) for b in blobs], out=offsets[1:])
+        buf = b"".join(blobs)
+        n = len(blobs)
+        ids = np.empty((n, seq_len), dtype=np.int32)
+        mask = np.empty((n, seq_len), dtype=np.int32)
+        lib.bcfl_hash_tokenize(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, seq_len, self.vocab_size,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         return ids, mask
 
 

@@ -1,4 +1,12 @@
-"""On-demand g++ build + ctypes binding for the native ledger core."""
+"""On-demand g++ build + ctypes binding for the native cores.
+
+One shared loader (lock, cache, mtime-based rebuild, graceful fallback)
+serves every native component; each public ``load_*_lib`` passes only its
+source/library paths and an argtypes-configuration callback. Everything here
+has a pure Python fallback, so the framework never hard-requires a
+toolchain: any failure — no g++, missing source, unloadable .so — returns
+None and the caller takes the Python path.
+"""
 
 from __future__ import annotations
 
@@ -6,20 +14,19 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "sha256.cc")
-_LIB = os.path.join(_DIR, "libbcfl_ledger.so")
 _lock = threading.Lock()
-_cached: Optional[ctypes.CDLL] = None
-_failed = False
+# src path -> (lib or None); None is cached too so a broken toolchain is
+# probed once per process, not once per call
+_cache: Dict[str, Tuple[bool, Optional[ctypes.CDLL]]] = {}
 
 
-def _compile() -> bool:
+def _compile(src: str, lib: str) -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", lib, src],
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -27,34 +34,64 @@ def _compile() -> bool:
         return False
 
 
+def _load_lib(src_name: str, lib_name: str,
+              configure: Callable[[ctypes.CDLL], None]) -> Optional[ctypes.CDLL]:
+    src = os.path.join(_DIR, src_name)
+    lib_path = os.path.join(_DIR, lib_name)
+    with _lock:
+        hit = _cache.get(src)
+        if hit is not None:
+            return hit[1]
+        lib = None
+        try:
+            # a shipped .so without its source is fine (no rebuild check);
+            # neither file existing is the no-toolchain fallback
+            if os.path.exists(src) and (
+                    not os.path.exists(lib_path)
+                    or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+                if not _compile(src, lib_path):
+                    _cache[src] = (True, None)
+                    return None
+            if os.path.exists(lib_path):
+                lib = ctypes.CDLL(lib_path)
+                configure(lib)
+        except OSError:
+            lib = None
+        _cache[src] = (True, lib)
+        return lib
+
+
+def _configure_ledger(lib: ctypes.CDLL) -> None:
+    lib.bcfl_sha256.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.bcfl_sha256_multi.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.c_char_p]
+    lib.bcfl_chain_extend.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+    lib.bcfl_chain_verify.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_char_p, ctypes.c_uint64]
+    lib.bcfl_chain_verify.restype = ctypes.c_int64
+
+
+def _configure_tokenizer(lib: ctypes.CDLL) -> None:
+    lib.bcfl_hash_tokenize.argtypes = [
+        ctypes.c_char_p,                  # concatenated lowered UTF-8
+        ctypes.POINTER(ctypes.c_int64),   # offsets [n+1]
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),   # ids [n, seq_len]
+        ctypes.POINTER(ctypes.c_int32),   # mask [n, seq_len]
+    ]
+
+
 def load_ledger_lib() -> Optional[ctypes.CDLL]:
     """The compiled ledger library, building it on first use; None if no
     toolchain is available (callers fall back to hashlib)."""
-    global _cached, _failed
-    with _lock:
-        if _cached is not None:
-            return _cached
-        if _failed:
-            return None
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _compile():
-                _failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
-            _failed = True
-            return None
-        lib.bcfl_sha256.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
-        lib.bcfl_sha256_multi.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint64, ctypes.c_char_p]
-        lib.bcfl_chain_extend.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
-        lib.bcfl_chain_verify.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_char_p, ctypes.c_uint64]
-        lib.bcfl_chain_verify.restype = ctypes.c_int64
-        _cached = lib
-        return lib
+    return _load_lib("sha256.cc", "libbcfl_ledger.so", _configure_ledger)
+
+
+def load_tokenizer_lib() -> Optional[ctypes.CDLL]:
+    """The compiled hash-tokenizer core, building it on first use; None if
+    no toolchain is available (callers fall back to the Python loop)."""
+    return _load_lib("tokenizer.cc", "libbcfl_tok.so", _configure_tokenizer)

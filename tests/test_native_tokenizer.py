@@ -1,0 +1,82 @@
+"""Bit-for-bit parity of the C++ hash-tokenizer core against the Python
+reference path (`HashTokenizer.encode`), which itself is pinned by
+tests/test_data.py. The native path must agree on EVERY byte of ids+mask —
+a silent divergence would re-tokenize every dataset differently depending on
+whether a toolchain is present."""
+
+import numpy as np
+import pytest
+
+from bcfl_tpu.data.tokenizer import HashTokenizer
+from bcfl_tpu.native.build import load_tokenizer_lib
+
+pytestmark = pytest.mark.skipif(
+    load_tokenizer_lib() is None, reason="no C++ toolchain")
+
+TRICKY = [
+    "",
+    " ",
+    "the quick brown fox",
+    "The QUICK Brown FOX!!",
+    "don't stop-me now; it's 2024...",
+    "  leading and   trailing   ",
+    "tabs\tnewlines\nand\r\nmore",
+    "unicode éÉ ß İ straße",  # ß lowers to ß; İ -> i̇ (2 cp)
+    "cjk 世界 and emoji \U0001f600\U0001f680",
+    "unicode spaces a b c d　e",
+    "mixed: café-naïve 'quoted' (parens) [brackets]",
+    "digits 0123456789 and '''apostrophes'''",
+    "ẞ",  # LATIN CAPITAL SHARP S lowers to U+00DF
+    "x" * 5000,  # single huge word
+    ("word " * 600).strip(),  # long doc, exercises the early-exit cap
+]
+
+
+def _python_batch(tok, texts, seq_len):
+    ids = np.empty((len(texts), seq_len), dtype=np.int32)
+    mask = np.empty((len(texts), seq_len), dtype=np.int32)
+    for i, t in enumerate(texts):
+        ids[i], mask[i] = tok.encode(t, seq_len)
+    return ids, mask
+
+
+@pytest.mark.parametrize("seq_len", [1, 2, 3, 16, 128])
+@pytest.mark.parametrize("vocab", [5, 8192, 30522])
+def test_parity_tricky(seq_len, vocab):
+    tok = HashTokenizer(vocab)
+    got = tok._encode_batch_native(TRICKY, seq_len)
+    assert got is not None
+    want = _python_batch(tok, TRICKY, seq_len)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_parity_fuzz():
+    rng = np.random.default_rng(0)
+    # random codepoints incl. multibyte planes, whitespace-heavy, ASCII
+    pools = [
+        list(range(0x20, 0x7F)),
+        [0x09, 0x0A, 0x20, 0xA0, 0x2003, 0x2028, 0x3000],
+        list(range(0x3B1, 0x3CA)) + list(range(0x4E00, 0x4E20)),
+        [0x1F600, 0x1F680, 0x10348],
+    ]
+    texts = []
+    for _ in range(200):
+        cps = []
+        for _ in range(int(rng.integers(0, 80))):
+            pool = pools[int(rng.integers(0, len(pools)))]
+            cps.append(chr(pool[int(rng.integers(0, len(pool)))]))
+        texts.append("".join(cps))
+    tok = HashTokenizer(512)
+    got = tok._encode_batch_native(texts, 32)
+    want = _python_batch(tok, texts, 32)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_encode_batch_uses_native_and_agrees():
+    tok = HashTokenizer(8192)
+    ids, mask = tok.encode_batch(TRICKY, 64)
+    want = _python_batch(tok, TRICKY, 64)
+    np.testing.assert_array_equal(ids, want[0])
+    np.testing.assert_array_equal(mask, want[1])

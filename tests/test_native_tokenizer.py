@@ -74,6 +74,21 @@ def test_parity_fuzz():
     np.testing.assert_array_equal(got[1], want[1])
 
 
+def test_lone_surrogate_falls_back_to_python_semantics():
+    """Texts with lone surrogates (errors='surrogateescape' reads) can't
+    cross the UTF-8 ctypes boundary: the native path must decline (return
+    None) so encode_batch behaves exactly like the Python path regardless
+    of toolchain — which tokenizes fine when the surrogate word lies beyond
+    the seq_len-2 cap."""
+    tok = HashTokenizer(512)
+    beyond_cap = "a b c d e f " + "\udcff"  # cap for seq_len=4 is 2 words
+    assert tok._encode_batch_native([beyond_cap], 4) is None
+    ids, mask = tok.encode_batch([beyond_cap, "plain"], 4)
+    want = _python_batch(tok, [beyond_cap, "plain"], 4)
+    np.testing.assert_array_equal(ids, want[0])
+    np.testing.assert_array_equal(mask, want[1])
+
+
 def test_encode_batch_uses_native_and_agrees():
     tok = HashTokenizer(8192)
     ids, mask = tok.encode_batch(TRICKY, 64)

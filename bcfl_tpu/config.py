@@ -119,6 +119,10 @@ class FedConfig:
     lora_rank: int = 0  # 0 = full fine-tune (reference behaviour); >0 = LoRA
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    # None = the model family's default (llama: flash on from seq 512;
+    # encoders: dense). True forces the O(S)-memory blockwise/Pallas
+    # attention path — the long-context switch, reachable from the CLI
+    use_flash: Optional[bool] = None
 
     # --- scale-out (SURVEY.md §2.5: the two axes the reference lacks) ---
     # tensor-parallel shards per client: tp > 1 builds a 2-D (clients, tp)
@@ -190,6 +194,11 @@ class FedConfig:
             raise ValueError("num_clients and num_rounds must be >= 1")
         if self.task not in ("classification", "causal_lm"):
             raise ValueError(f"unknown task: {self.task!r}")
+        for field in ("param_dtype", "compute_dtype"):
+            if getattr(self, field) not in ("float32", "bfloat16", "float16"):
+                raise ValueError(
+                    f"{field} must be float32/bfloat16/float16, "
+                    f"got {getattr(self, field)!r}")
         if self.tp < 1:
             raise ValueError(f"tp must be >= 1, got {self.tp}")
         if self.tp > 1 and self.lora_rank <= 0:

@@ -53,6 +53,15 @@ def main(argv=None):
                          "(jax.distributed must be initialized; see "
                          "core.mesh.distributed_init)")
     ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--use-flash", choices=["on", "off"], default=None,
+                    help="force the O(S)-memory blockwise/Pallas attention "
+                         "path on or off (default: the model family's "
+                         "choice — llama flashes from seq 512, encoders "
+                         "stay dense)")
+    ap.add_argument("--compute-dtype", default=None,
+                    choices=["float32", "bfloat16", "float16"])
+    ap.add_argument("--param-dtype", default=None,
+                    choices=["float32", "bfloat16", "float16"])
     ap.add_argument("--faithful", action="store_true",
                     help="reference-exact sequential serverless semantics")
     ap.add_argument("--anomaly-filter",
@@ -84,6 +93,7 @@ def main(argv=None):
         "max_local_batches": "max_local_batches", "seed": "seed",
         "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
+        "compute_dtype": "compute_dtype", "param_dtype": "param_dtype",
     }
     overrides = {}
     for arg_name, cfg_name in simple.items():
@@ -98,6 +108,8 @@ def main(argv=None):
                 f"under --hf use one of {sorted(_HF)}")
         overrides["hf_checkpoint"] = _HF[args.model]
         overrides["tokenizer"] = _HF[args.model]
+    if args.use_flash is not None:
+        overrides["use_flash"] = args.use_flash == "on"
     if args.faithful:
         overrides["faithful"] = True
     if args.anomaly_filter is not None:

@@ -109,6 +109,17 @@ class FedEngine:
         )
 
         # --- model ---
+        # dtype/attention knobs flow from the config into EVERY build path:
+        # a config that says float32 compute must not silently train bf16
+        dtype_overrides = {"dtype": jnp.dtype(cfg.compute_dtype),
+                           "param_dtype": jnp.dtype(cfg.param_dtype)}
+        if cfg.use_flash is not None:
+            dtype_overrides["use_flash"] = cfg.use_flash
+            if cfg.use_flash:
+                # an explicit "on" FORCES the blockwise path at every
+                # length (both families otherwise gate on flash_min_seq,
+                # which would silently run dense attention below 512)
+                dtype_overrides["flash_min_seq"] = 0
         if cfg.hf_checkpoint is not None:
             if cfg.task == "causal_lm":
                 raise ValueError(
@@ -120,8 +131,14 @@ class FedEngine:
                 cfg.hf_checkpoint, num_labels=self.num_labels,
                 reinit_classifier=True,
             )
+            model_cfg = dataclasses.replace(model_cfg, **dtype_overrides)
             self.model = TextClassifier(model_cfg)
-            params = variables["params"]
+            # the importer materializes float32; the configured param dtype
+            # must apply to the ARRAYS, not just the config record
+            params = jax.tree.map(
+                lambda x: x.astype(model_cfg.param_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                variables["params"])
         else:
             from bcfl_tpu.models import build as build_model
 
@@ -129,6 +146,7 @@ class FedEngine:
                 cfg.model, num_labels=self.num_labels,
                 vocab_size=self.tokenizer.vocab_size,
                 head="lm" if cfg.task == "causal_lm" else "classifier",
+                **dtype_overrides,
             )
             ids = jnp.ones((2, cfg.seq_len), jnp.int32)
             params = self.model.init(
@@ -361,9 +379,21 @@ class FedEngine:
                         f"checkpoint was written with seed {int(ck_seed)} but "
                         f"config has seed {cfg.seed}: resuming would break the "
                         "per-(client, round) RNG stream")
+                # checkpoints written under a different param_dtype must not
+                # silently override the configured one on resume
+                pd = jnp.dtype(cfg.param_dtype)
+
+                def _cast(t):
+                    return jax.tree.map(
+                        lambda x: jnp.asarray(x, pd)
+                        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                        else jnp.asarray(x), t)
+
                 if state.get("stacked") is not None:
-                    stacked = self.mesh.shard_clients(state["stacked"])
-                trainable = state["trainable"]
+                    stacked = self.mesh.shard_clients(_cast(state["stacked"]))
+                # replicate: a resumed tree left on the default device would
+                # re-trigger the round-2 recompile (tests/test_recompile.py)
+                trainable = self.mesh.replicate(_cast(state["trainable"]))
                 if ledger_json and self.ledger is not None:
                     self.ledger = Ledger.from_json(
                         ledger_json, cfg.ledger.use_native)

@@ -45,6 +45,7 @@ class EncoderConfig:
     share_layers: bool = False  # ALBERT-style cross-layer parameter sharing
     embedding_size: Optional[int] = None  # ALBERT factorized embeddings; None = hidden
     use_flash: bool = False  # Pallas blockwise attention for long sequences
+    flash_min_seq: int = 512  # below this, dense attention is faster
     dtype: jnp.dtype = jnp.bfloat16  # compute dtype
     param_dtype: jnp.dtype = jnp.float32
 
@@ -69,7 +70,7 @@ class SelfAttention(nn.Module):
         q = dense("query")(x).transpose(0, 2, 1, 3)
         k = dense("key")(x).transpose(0, 2, 1, 3)
         v = dense("value")(x).transpose(0, 2, 1, 3)
-        if c.use_flash and x.shape[1] >= 512:
+        if c.use_flash and x.shape[1] >= c.flash_min_seq:
             from bcfl_tpu.ops.flash import flash_attention
 
             out = flash_attention(q, k, v, bias)

@@ -1,0 +1,80 @@
+"""FedConfig dtype/attention knobs must reach the built model.
+
+``param_dtype``/``compute_dtype`` were config fields with no consumer —
+a config saying float32 compute silently trained bf16. Pin the full path:
+config -> engine -> model config -> actual param dtypes.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from bcfl_tpu.config import FedConfig, PartitionConfig
+from bcfl_tpu.fed.engine import FedEngine
+
+
+def _engine(**kw):
+    base = dict(
+        name="dtypes", model="tiny-bert", dataset="synthetic",
+        num_clients=2, num_rounds=1, seq_len=16, batch_size=4,
+        max_local_batches=1,
+        partition=PartitionConfig(kind="iid", iid_samples=8))
+    base.update(kw)
+    return FedEngine(FedConfig(**base))
+
+
+def test_default_dtypes_reach_model():
+    eng = _engine()
+    assert eng.model.cfg.dtype == jnp.bfloat16
+    assert eng.model.cfg.param_dtype == jnp.float32
+
+
+def test_float32_compute_is_honored():
+    import jax
+
+    eng = _engine(compute_dtype="float32")
+    assert eng.model.cfg.dtype == jnp.float32
+    # params actually materialize in the configured dtype
+    assert all(x.dtype == jnp.float32
+               for x in jax.tree.leaves(eng.trainable0))
+
+
+def test_use_flash_override_reaches_model():
+    eng = _engine(use_flash=True)
+    assert eng.model.cfg.use_flash is True
+    assert _engine().model.cfg.use_flash is False  # encoder default
+
+
+def test_llama_use_flash_default_survives():
+    # None must NOT stomp llama's family default (flash on)
+    eng = _engine(model="tiny-llama", lora_rank=2)
+    assert eng.model.cfg.use_flash is True
+
+
+def test_bad_dtype_rejected():
+    with pytest.raises(ValueError, match="compute_dtype"):
+        FedConfig(compute_dtype="float64")
+
+
+def test_use_flash_on_forces_every_length():
+    # an explicit "on" zeroes the flash_min_seq gate — without this, short
+    # sequences would silently run dense attention despite the flag
+    assert _engine(use_flash=True).model.cfg.flash_min_seq == 0
+
+
+def test_resume_does_not_override_configured_param_dtype(tmp_path):
+    import jax
+
+    from bcfl_tpu.entrypoints.run import run
+
+    base = dict(
+        name="dtype_resume", model="tiny-bert", dataset="synthetic",
+        num_clients=2, num_rounds=1, seq_len=16, batch_size=4,
+        max_local_batches=1, checkpoint_dir=str(tmp_path),
+        checkpoint_every=1,
+        partition=PartitionConfig(kind="iid", iid_samples=8))
+    run(FedConfig(**base), verbose=False)  # writes a float32 checkpoint
+    res = run(FedConfig(**{**base, "num_rounds": 2,
+                           "param_dtype": "bfloat16"}),
+              resume=True, verbose=False)
+    assert all(x.dtype == jnp.bfloat16
+               for x in jax.tree.leaves(res.trainable))

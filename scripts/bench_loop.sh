@@ -32,8 +32,8 @@ print(d[0].device_kind)
 " >> "$LOG" 2>&1
 }
 
-run_bench() {  # $1 = mode, $2 = out file
-  BCFL_BENCH_RETRIES=0 BCFL_BENCH_MODE="$1" \
+run_bench() {  # $1 = mode, $2 = out file, [$3 = extra env "K=V"]
+  BCFL_BENCH_RETRIES=0 BCFL_BENCH_MODE="$1" ${3:+env "$3"} \
     timeout -k 10 7200 python bench.py > /tmp/bench_out_$1.txt 2>> "$LOG"
   cat /tmp/bench_out_$1.txt >> "$LOG"
   local line
@@ -88,9 +88,16 @@ while true; do
         touch results/dispatch_bisect_failed
       fi
     fi
+    # bonus row: the TPU hardware PRNG (dropout RNG is +38% of step time
+    # under threefry, PERF.md); recorded separately, never the headline
+    if [ ! -f results/bench_r04_rbg.json ]; then
+      run_bench server results/bench_r04_rbg.json BCFL_BENCH_PRNG=rbg \
+        || say "rbg bonus bench failed (non-gating)"
+    fi
     if [ ! -f results/tpu_perf_done ]; then
       say "running tpu_perf sweep"
       if timeout -k 10 14400 python scripts/tpu_perf.py \
+           --trace-dir results/perf_trace \
            >> results/tpu_perf_r04.log 2>&1; then
         touch results/tpu_perf_done
         say "tpu_perf done -> PERF.md"

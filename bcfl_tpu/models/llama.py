@@ -20,7 +20,7 @@ This module provides that model family TPU-first:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -52,6 +52,11 @@ class LlamaConfig:
     flash_min_seq: int = 512  # below this, dense attention is faster
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
+    # sequence parallelism hook: a callable (q, k, v, key_bias, causal=...)
+    # -> out that replaces the attention op — e.g. ring attention over a
+    # 'seq' mesh axis (bcfl_tpu.parallel.sp.ring_config). Static module
+    # config; None = the flash/dense selection above.
+    attention_override: Optional[Callable] = None
 
     @property
     def head_dim(self) -> int:
@@ -111,7 +116,9 @@ class LlamaAttention(nn.Module):
             rep = c.num_heads // c.kv_heads
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        if bias is None:
+        if c.attention_override is not None:
+            out = c.attention_override(q, k, v, key_bias, causal=True)
+        elif bias is None:
             from bcfl_tpu.ops.flash import flash_attention
 
             out = flash_attention(q, k, v, key_bias, causal=True)
@@ -166,10 +173,11 @@ class LlamaModel(nn.Module):
         x = nn.Embed(c.vocab_size, c.hidden_size, param_dtype=c.param_dtype,
                      name="embed")(ids).astype(c.dtype)
         use_flash = c.use_flash and ids.shape[1] >= c.flash_min_seq
-        # flash path: causal triangle + padding handled blockwise inside the
-        # kernel; the dense [B,1,S,S] bias (O(S^2) memory) only exists for
-        # short sequences where it is cheaper than the blockwise recurrence
-        bias = None if use_flash else causal_bias(mask)
+        # flash/ring paths: causal triangle + padding handled blockwise; the
+        # dense [B,1,S,S] bias (O(S^2) memory) only exists for short
+        # sequences where it is cheaper than the blockwise recurrence
+        bias = (None if use_flash or c.attention_override is not None
+                else causal_bias(mask))
         key_bias = jnp.where(mask > 0, 0.0, -1e30).astype(jnp.float32)
         positions = jnp.arange(ids.shape[1])
         for i in range(c.num_layers):

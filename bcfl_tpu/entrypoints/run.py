@@ -74,13 +74,19 @@ def run_sweep(
     client_counts: Optional[List[int]] = None,
     resume: bool = False,
     verbose: bool = True,
+    out_dir: Optional[str] = "results",
 ) -> Dict[int, RunResult]:
     """The reference's worker sweep (``for NUM_CLIENTS in [5,10,20]``,
     ``serverless_cancer_biobert_allclients.py:41``) over one config. Each
-    client count checkpoints into its own subdirectory."""
+    client count checkpoints into its own subdirectory. ``out_dir`` gets
+    the reference notebooks' sweep figure set (latency/accuracy/memory by
+    client count — cells 15/18/21) plus ``<name>_sweep.json``; None skips
+    recording."""
+    import json
     import os
 
     from bcfl_tpu.entrypoints.presets import SWEEP_CLIENTS
+    from bcfl_tpu.viz import sweep_report
 
     out: Dict[int, RunResult] = {}
     for n in client_counts or SWEEP_CLIENTS:
@@ -90,4 +96,22 @@ def run_sweep(
             cfg.replace(name=f"{cfg.name}_c{n}", num_clients=n,
                         checkpoint_dir=ckpt),
             resume=resume, verbose=verbose)
+    if out_dir:
+        paths = sweep_report(out, out_dir, name=f"{cfg.name}_sweep")
+        record = {
+            str(n): {
+                "final_acc": (r.metrics.global_accuracies[-1]
+                              if r.metrics.global_accuracies else None),
+                "latency_min": sum(x.wall_s for x in r.metrics.rounds) / 60.0,
+                "memory_gb": r.metrics.resources.get("memory_gb"),
+            } for n, r in out.items()
+        }
+        jpath = os.path.join(out_dir, f"{cfg.name}_sweep.json")
+        with open(jpath, "w") as f:
+            json.dump({"model": cfg.model, "dataset": cfg.dataset,
+                       "rounds": cfg.num_rounds, "mode": cfg.mode,
+                       "counts": sorted(out), "runs": record}, f, indent=2)
+        if verbose:
+            print(f"sweep artifacts: {jpath} + {len(paths)} figures",
+                  flush=True)
     return out

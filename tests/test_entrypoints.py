@@ -80,3 +80,28 @@ def test_graft_entry_hooks():
     logits = jax.jit(fn)(*args)
     assert logits.shape == (8, 2)
     g.dryrun_multichip(len(jax.devices()))
+
+
+def test_run_sweep_records_artifacts(tmp_path):
+    """--sweep must emit the reference notebooks' figure set (latency /
+    accuracy / memory by client count, cells 15/18/21) + a JSON record."""
+    import json
+
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.entrypoints.run import run_sweep
+
+    cfg = FedConfig(
+        name="sweeptest", model="tiny-bert", dataset="synthetic",
+        mode="serverless", num_clients=2, num_rounds=1, seq_len=16,
+        batch_size=4, max_local_batches=1,
+        partition=PartitionConfig(kind="iid", iid_samples=8))
+    out = run_sweep(cfg, client_counts=[2, 4], verbose=False,
+                    out_dir=str(tmp_path))
+    assert sorted(out) == [2, 4]
+    rec = json.loads((tmp_path / "sweeptest_sweep.json").read_text())
+    assert rec["counts"] == [2, 4]
+    assert all(rec["runs"][k]["final_acc"] is not None for k in ("2", "4"))
+    figs = sorted(p.name for p in tmp_path.glob("*.png"))
+    assert figs == ["sweeptest_sweep_accuracy.png",
+                    "sweeptest_sweep_latency.png",
+                    "sweeptest_sweep_memory.png"]

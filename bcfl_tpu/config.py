@@ -129,6 +129,12 @@ class FedConfig:
     # mesh, shards the FROZEN base megatron-style (requires lora_rank > 0 —
     # adapters stay per-client), and runs the same GSPMD round programs
     tp: int = 1
+    # sequence-parallel shards per client: sp > 1 builds a 2-D
+    # (clients, seq) mesh and swaps the model's attention for exact ring
+    # attention over the seq axis (bcfl_tpu.parallel.sp) — each client's
+    # ACTIVATIONS shard over the sequence, params stay replicated in the
+    # group. Long-document federated fine-tuning; llama family only.
+    sp: int = 1
     # build the mesh over every host in the pod (jax.distributed must be
     # initialized first — core.mesh.distributed_init); devices are ordered
     # hosts-major so collectives ride ICI and cross DCN once
@@ -199,8 +205,18 @@ class FedConfig:
                 raise ValueError(
                     f"{field} must be float32/bfloat16/float16, "
                     f"got {getattr(self, field)!r}")
-        if self.tp < 1:
-            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.tp < 1 or self.sp < 1:
+            raise ValueError(f"tp/sp must be >= 1, got {self.tp}/{self.sp}")
+        if self.tp > 1 and self.sp > 1:
+            raise ValueError("pick ONE inner mesh axis per run: tp or sp")
+        if self.sp > 1 and self.hf_checkpoint is not None:
+            raise ValueError(
+                "sp > 1 needs the llama family's attention hook; the HF "
+                "import path builds encoders")
+        if self.sp > 1 and self.seq_len % self.sp:
+            raise ValueError(
+                f"seq_len {self.seq_len} must be divisible by sp={self.sp} "
+                "(ring attention shards the sequence into sp equal blocks)")
         if self.tp > 1 and self.lora_rank <= 0:
             raise ValueError(
                 "tp > 1 tensor-shards the FROZEN base and keeps per-client "

@@ -50,6 +50,7 @@ class ClientMesh:
     num_clients: int
     per_device: int
     tp: int = 1
+    sp: int = 1  # sequence-parallel shards per client ('seq' axis)
 
     @property
     def n_devices(self) -> int:
@@ -173,6 +174,7 @@ def client_mesh(
     num_clients: int,
     devices: Optional[Sequence[jax.Device]] = None,
     tp: int = 1,
+    sp: int = 1,
 ) -> ClientMesh:
     """Build the clients mesh.
 
@@ -184,20 +186,29 @@ def client_mesh(
     ``tp > 1`` reserves that many devices per client shard on an inner ``tp``
     axis (2-D ``(clients, tp)`` mesh — tp innermost so a client's
     tensor-parallel collectives ride adjacent-ICI links; see
-    :class:`ClientMesh`).
+    :class:`ClientMesh`). ``sp > 1`` instead reserves an inner ``seq`` axis:
+    each client's ACTIVATIONS shard over the sequence (ring attention,
+    :mod:`bcfl_tpu.parallel.sp`) while params stay replicated within the
+    group — the long-document federated composition.
     """
     devices = list(devices if devices is not None else jax.devices())
-    if tp < 1:
-        raise ValueError(f"tp must be >= 1, got {tp}")
-    if tp > 1:
-        if len(devices) < tp:
+    if tp < 1 or sp < 1:
+        raise ValueError(f"tp/sp must be >= 1, got tp={tp} sp={sp}")
+    if tp > 1 and sp > 1:
+        raise ValueError(
+            "compose one inner axis per run: tp x sp 3-D meshes are not "
+            "supported (pick tensor OR sequence parallelism per client)")
+    inner_n, inner_axis = (tp, "tp") if tp > 1 else (sp, "seq")
+    if inner_n > 1:
+        if len(devices) < inner_n:
             raise ValueError(
-                f"tp={tp} needs at least tp devices, have {len(devices)}")
-        d = _largest_divisor_leq(num_clients, len(devices) // tp)
-        mesh = Mesh(np.asarray(devices[:d * tp]).reshape(d, tp),
-                    (CLIENT_AXIS, "tp"))
+                f"{inner_axis}={inner_n} needs at least that many devices, "
+                f"have {len(devices)}")
+        d = _largest_divisor_leq(num_clients, len(devices) // inner_n)
+        mesh = Mesh(np.asarray(devices[:d * inner_n]).reshape(d, inner_n),
+                    (CLIENT_AXIS, inner_axis))
         return ClientMesh(mesh=mesh, num_clients=num_clients,
-                          per_device=num_clients // d, tp=tp)
+                          per_device=num_clients // d, tp=tp, sp=sp)
     d = _largest_divisor_leq(num_clients, len(devices))
     mesh = Mesh(np.array(devices[:d]), (CLIENT_AXIS,))
     return ClientMesh(mesh=mesh, num_clients=num_clients, per_device=num_clients // d)

@@ -45,6 +45,10 @@ def main(argv=None):
                          "fuses too via in-graph fingerprints — only anomaly "
                          "filters, tamper hooks, and faithful mode fall back "
                          "to per-round)")
+    ap.add_argument("--sp", type=int, default=None,
+                    help="sequence-parallel shards per client: 2-D "
+                         "(clients, seq) mesh, ring attention over the seq "
+                         "axis (llama family)")
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel shards per client (2-D clients x tp "
                          "mesh; requires --lora-rank > 0)")
@@ -91,7 +95,7 @@ def main(argv=None):
         "seq_len": "seq_len", "batch_size": "batch_size",
         "lr": "learning_rate", "lora_rank": "lora_rank",
         "max_local_batches": "max_local_batches", "seed": "seed",
-        "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp",
+        "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp", "sp": "sp",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
         "compute_dtype": "compute_dtype", "param_dtype": "param_dtype",
     }

@@ -108,6 +108,14 @@ class FedEngine:
             jax.random.fold_in(self.root_key, 1),
         )
 
+        # --- mesh (before the model: sp injects the mesh into attention) ---
+        # pod=True spans every host's devices (hosts-major, DCN-outermost);
+        # tp>1 makes the mesh 2-D (clients, tp) and megatron-shards the
+        # frozen base; sp>1 makes it (clients, seq) and rides ring attention
+        devices = pod_devices() if cfg.pod else None
+        self.mesh = client_mesh(cfg.num_clients, devices=devices,
+                                tp=cfg.tp, sp=cfg.sp)
+
         # --- model ---
         # dtype/attention knobs flow from the config into EVERY build path:
         # a config that says float32 compute must not silently train bf16
@@ -120,6 +128,23 @@ class FedEngine:
                 # length (both families otherwise gate on flash_min_seq,
                 # which would silently run dense attention below 512)
                 dtype_overrides["flash_min_seq"] = 0
+        if cfg.sp > 1:
+            from bcfl_tpu.models import get_config as get_model_config
+            from bcfl_tpu.parallel.sp import SEQ_AXIS, ring_override
+
+            # each client's attention becomes exact ring attention over the
+            # mesh's seq axis (activations shard O(S/sp) per device); only
+            # the llama family exposes the hook — reject encoders HERE with
+            # the knob named, not via a trace-time TypeError
+            if not hasattr(get_model_config(cfg.model),
+                           "attention_override"):
+                raise ValueError(
+                    f"sp > 1 needs the llama family's attention hook; "
+                    f"model {cfg.model!r} is an encoder")
+            assert SEQ_AXIS in self.mesh.mesh.shape
+            dtype_overrides["attention_override"] = ring_override(
+                self.mesh.mesh)
+            dtype_overrides["use_flash"] = False
         if cfg.hf_checkpoint is not None:
             if cfg.task == "causal_lm":
                 raise ValueError(
@@ -163,12 +188,7 @@ class FedEngine:
             self.frozen = None
             self.trainable0 = params
 
-        # --- mesh + programs ---
-        # pod=True spans every host's devices (hosts-major, DCN-outermost);
-        # tp>1 makes the mesh 2-D (clients, tp) and megatron-shards the
-        # frozen base so each client's forward/backward spans tp chips
-        devices = pod_devices() if cfg.pod else None
-        self.mesh = client_mesh(cfg.num_clients, devices=devices, tp=cfg.tp)
+        # --- programs ---
         if cfg.tp > 1:
             from jax.sharding import NamedSharding
 

@@ -18,4 +18,5 @@ from bcfl_tpu.parallel.sp import (  # noqa: F401
     init_sp_lm,
     make_sp_lm_train_step,
     ring_config,
+    ring_override,
 )

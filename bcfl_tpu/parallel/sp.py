@@ -34,21 +34,26 @@ from bcfl_tpu.parallel.ring_attention import ring_attention_gspmd
 SEQ_AXIS = "seq"
 
 
+def ring_override(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """The attention-override callable: exact ring attention over ``mesh``'s
+    ``axis_name`` axis. One definition — :func:`ring_config` and the
+    engine's ``FedConfig(sp=...)`` path both wire exactly this."""
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.shape}")
+    return functools.partial(ring_attention_gspmd, mesh=mesh,
+                             axis_name=axis_name)
+
+
 def ring_config(model_cfg, mesh: Mesh, axis_name: str = SEQ_AXIS):
     """A copy of ``model_cfg`` whose attention is exact ring attention over
     ``mesh``'s ``axis_name`` axis. Works for any config exposing the
     ``attention_override`` hook (llama family)."""
-    if axis_name not in mesh.shape:
-        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.shape}")
     if not hasattr(model_cfg, "attention_override"):
         raise ValueError(
             f"{type(model_cfg).__name__} has no attention_override hook — "
             "sequence parallelism needs the llama (decoder) family")
     return dataclasses.replace(
-        model_cfg,
-        attention_override=functools.partial(
-            ring_attention_gspmd, mesh=mesh, axis_name=axis_name),
-    )
+        model_cfg, attention_override=ring_override(mesh, axis_name))
 
 
 def make_sp_lm_train_step(model, mesh: Mesh, axis_name: str = SEQ_AXIS,

@@ -133,7 +133,8 @@ class FedConfig:
     # (clients, seq) mesh and swaps the model's attention for exact ring
     # attention over the seq axis (bcfl_tpu.parallel.sp) — each client's
     # ACTIVATIONS shard over the sequence, params stay replicated in the
-    # group. Long-document federated fine-tuning; llama family only.
+    # group. Long-document federated fine-tuning; both model families
+    # (encoders ride the non-causal ring).
     sp: int = 1
     # build the mesh over every host in the pod (jax.distributed must be
     # initialized first — core.mesh.distributed_init); devices are ordered
@@ -209,10 +210,6 @@ class FedConfig:
             raise ValueError(f"tp/sp must be >= 1, got {self.tp}/{self.sp}")
         if self.tp > 1 and self.sp > 1:
             raise ValueError("pick ONE inner mesh axis per run: tp or sp")
-        if self.sp > 1 and self.hf_checkpoint is not None:
-            raise ValueError(
-                "sp > 1 needs the llama family's attention hook; the HF "
-                "import path builds encoders")
         if self.sp > 1 and self.seq_len % self.sp:
             raise ValueError(
                 f"seq_len {self.seq_len} must be divisible by sp={self.sp} "

@@ -129,18 +129,12 @@ class FedEngine:
                 # which would silently run dense attention below 512)
                 dtype_overrides["flash_min_seq"] = 0
         if cfg.sp > 1:
-            from bcfl_tpu.models import get_config as get_model_config
             from bcfl_tpu.parallel.sp import SEQ_AXIS, ring_override
 
             # each client's attention becomes exact ring attention over the
-            # mesh's seq axis (activations shard O(S/sp) per device); only
-            # the llama family exposes the hook — reject encoders HERE with
-            # the knob named, not via a trace-time TypeError
-            if not hasattr(get_model_config(cfg.model),
-                           "attention_override"):
-                raise ValueError(
-                    f"sp > 1 needs the llama family's attention hook; "
-                    f"model {cfg.model!r} is an encoder")
+            # mesh's seq axis (activations shard O(S/sp) per device); both
+            # model families expose the hook — llama rides the causal ring,
+            # encoders the non-causal one
             assert SEQ_AXIS in self.mesh.mesh.shape
             dtype_overrides["attention_override"] = ring_override(
                 self.mesh.mesh)

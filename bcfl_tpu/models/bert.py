@@ -22,7 +22,7 @@ HF checkpoint weights import via :mod:`bcfl_tpu.models.hf_import`.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -46,6 +46,12 @@ class EncoderConfig:
     embedding_size: Optional[int] = None  # ALBERT factorized embeddings; None = hidden
     use_flash: bool = False  # Pallas blockwise attention for long sequences
     flash_min_seq: int = 512  # below this, dense attention is faster
+    # sequence-parallelism hook (same contract as LlamaConfig's): a callable
+    # (q, k, v, key_bias, causal=False) -> out replacing the attention op,
+    # e.g. ring attention over a 'seq' mesh axis (bcfl_tpu.parallel.sp).
+    # Long-document ENCODER classification — the reference's medical
+    # transcriptions are exactly this shape of input.
+    attention_override: Optional[Callable] = None
     dtype: jnp.dtype = jnp.bfloat16  # compute dtype
     param_dtype: jnp.dtype = jnp.float32
 
@@ -58,7 +64,7 @@ class SelfAttention(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool):
+    def __call__(self, x, bias, deterministic: bool, key_bias=None):
         c = self.cfg
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             features=(c.num_heads, c.head_dim),
@@ -70,7 +76,9 @@ class SelfAttention(nn.Module):
         q = dense("query")(x).transpose(0, 2, 1, 3)
         k = dense("key")(x).transpose(0, 2, 1, 3)
         v = dense("value")(x).transpose(0, 2, 1, 3)
-        if c.use_flash and x.shape[1] >= c.flash_min_seq:
+        if c.attention_override is not None:
+            out = c.attention_override(q, k, v, key_bias, causal=False)
+        elif c.use_flash and x.shape[1] >= c.flash_min_seq:
             from bcfl_tpu.ops.flash import flash_attention
 
             out = flash_attention(q, k, v, bias)
@@ -91,9 +99,10 @@ class EncoderLayer(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool):
+    def __call__(self, x, bias, deterministic: bool, key_bias=None):
         c = self.cfg
-        a = SelfAttention(c, name="attention")(x, bias, deterministic)
+        a = SelfAttention(c, name="attention")(x, bias, deterministic,
+                                               key_bias)
         x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
                          param_dtype=c.param_dtype, name="attention_norm")(x + a)
         h = nn.Dense(c.intermediate_size, dtype=c.dtype, param_dtype=c.param_dtype,
@@ -137,14 +146,19 @@ class Encoder(nn.Module):
         if type_ids is None:
             type_ids = jnp.zeros_like(ids)
         x = Embeddings(c, name="embeddings")(ids, type_ids, deterministic)
-        bias = attention_bias_from_mask(mask, dtype=jnp.float32)
+        # override (ring/SP) path: padding rides the [B, S] key bias, so the
+        # dense O(S^2) bias tensor is never materialized
+        bias = (None if c.attention_override is not None
+                else attention_bias_from_mask(mask, dtype=jnp.float32))
+        key_bias = jnp.where(mask > 0, 0.0, -1e30).astype(jnp.float32)
         if c.share_layers:
             layer = EncoderLayer(c, name="layer_shared")
             for _ in range(c.num_layers):
-                x = layer(x, bias, deterministic)
+                x = layer(x, bias, deterministic, key_bias)
         else:
             for i in range(c.num_layers):
-                x = EncoderLayer(c, name=f"layer_{i}")(x, bias, deterministic)
+                x = EncoderLayer(c, name=f"layer_{i}")(x, bias, deterministic,
+                                                       key_bias)
         return x
 
 

@@ -2,7 +2,8 @@
 
 The reference caps sequences at one process's memory (torch dense attention,
 `src/Serverlesscase/serverless_NonIID_IMDB.py:84` truncates at the model
-max). Here a decoder trains on sequences sharded over a ``seq`` mesh axis:
+max). Here a model — decoder (causal) or encoder (bidirectional, padding via the
+[B, S] key bias) — trains on sequences sharded over a ``seq`` mesh axis:
 :func:`ring_config` swaps the model's attention op for exact ring attention
 (:func:`bcfl_tpu.parallel.ring_attention.ring_attention_gspmd` — KV blocks
 rotate via collective-permute, O(S/n) activations per device), and
@@ -46,12 +47,13 @@ def ring_override(mesh: Mesh, axis_name: str = SEQ_AXIS):
 
 def ring_config(model_cfg, mesh: Mesh, axis_name: str = SEQ_AXIS):
     """A copy of ``model_cfg`` whose attention is exact ring attention over
-    ``mesh``'s ``axis_name`` axis. Works for any config exposing the
-    ``attention_override`` hook (llama family)."""
+    ``mesh``'s ``axis_name`` axis. Both model families expose the
+    ``attention_override`` hook: llama rides the causal ring, encoders the
+    non-causal one (padding via the [B, S] key bias)."""
     if not hasattr(model_cfg, "attention_override"):
         raise ValueError(
             f"{type(model_cfg).__name__} has no attention_override hook — "
-            "sequence parallelism needs the llama (decoder) family")
+            "sequence parallelism needs a config exposing it")
     return dataclasses.replace(
         model_cfg, attention_override=ring_override(mesh, axis_name))
 

@@ -39,10 +39,15 @@ def test_fed_sp_serverless_gossip():
     assert np.isfinite([r.train_loss for r in res.metrics.rounds]).all()
 
 
-def test_sp_rejects_encoders():
-    with pytest.raises(ValueError, match="llama"):
-        FedEngine(_cfg(model="tiny-bert", task="classification",
-                       lora_rank=0))
+def test_sp_encoder_classification():
+    """Encoders ride the NON-causal ring: long-document classification
+    (the reference's medical-transcriptions shape) with the sequence
+    sharded per client."""
+    eng = FedEngine(_cfg(model="tiny-bert", task="classification",
+                         lora_rank=0, num_rounds=1))
+    assert eng.model.cfg.attention_override is not None
+    res = eng.run()
+    assert np.isfinite(res.metrics.rounds[0].train_loss)
 
 
 def test_sp_tp_exclusive():

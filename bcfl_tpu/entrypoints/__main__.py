@@ -48,7 +48,7 @@ def main(argv=None):
     ap.add_argument("--sp", type=int, default=None,
                     help="sequence-parallel shards per client: 2-D "
                          "(clients, seq) mesh, ring attention over the seq "
-                         "axis (llama family)")
+                         "axis (llama causal / encoder non-causal)")
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel shards per client (2-D clients x tp "
                          "mesh; requires --lora-rank > 0)")

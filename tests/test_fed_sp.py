@@ -55,6 +55,15 @@ def test_sp_tp_exclusive():
         _cfg(tp=2)
 
 
+def test_sp_async_buffered():
+    """Buffered-async aggregation composes with the (clients, seq) mesh:
+    local_updates/collapse run the same GSPMD programs over the 2-D mesh."""
+    eng = FedEngine(_cfg(mode="serverless", sync="async", async_buffer=1,
+                         num_rounds=2))
+    res = eng.run()
+    assert np.isfinite([r.train_loss for r in res.metrics.rounds]).all()
+
+
 def test_sp_full_finetune_also_works():
     # unlike tp (frozen-base sharding -> needs LoRA), sp shards only
     # activations: full fine-tune composes

@@ -107,3 +107,34 @@ def test_engine_hf_checkpoint_path_runs_offline(tmp_path):
     res = eng.run()
     assert len(res.metrics.rounds) == 1
     assert np.isfinite(res.metrics.rounds[0].train_loss)
+
+
+def test_engine_hf_checkpoint_with_sp(tmp_path):
+    """HF-imported encoders compose with FedConfig(sp=...): the imported
+    EncoderConfig gets the ring attention_override, so pretrained
+    long-document classification can shard the sequence per client."""
+    ckpt = tmp_path / "mock-bert-sp"
+    hf_cfg = transformers.BertConfig(
+        vocab_size=32, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=40, num_labels=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    transformers.BertForSequenceClassification(hf_cfg).save_pretrained(ckpt)
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "a", "b"]
+    (tmp_path / "vocab.txt").write_text("\n".join(vocab))
+    transformers.BertTokenizerFast(
+        str(tmp_path / "vocab.txt"), do_lower_case=True).save_pretrained(ckpt)
+
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.fed.engine import FedEngine
+
+    cfg = FedConfig(
+        dataset="synthetic", num_labels=2, seq_len=32, batch_size=2,
+        model="biobert-base", hf_checkpoint=str(ckpt), tokenizer=str(ckpt),
+        num_clients=2, num_rounds=1, max_local_batches=1, sp=2,
+        partition=PartitionConfig(kind="iid", iid_samples=4))
+    eng = FedEngine(cfg)
+    assert eng.model.cfg.attention_override is not None
+    assert eng.mesh.mesh.shape == {"clients": 2, "seq": 2}
+    res = eng.run()
+    assert np.isfinite(res.metrics.rounds[0].train_loss)

@@ -42,7 +42,9 @@ def _time_call(fn, *args, iters=3, warmup=1):
 
 def bench_sweep(trace_dir=None, quick=False):
     """Headline bench at several (rounds, steps) dispatch shapes."""
-    shapes = [(1, 4), (4, 8)] if quick else [(1, 4), (1, 8), (4, 8), (8, 8)]
+    # (32, 8) last = the headline bench's default dispatch shape
+    shapes = ([(1, 4), (4, 8)] if quick
+              else [(1, 4), (1, 8), (4, 8), (8, 8), (32, 8)])
     rows = []
     for rounds, steps in shapes:
         env = dict(os.environ,

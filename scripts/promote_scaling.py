@@ -1,0 +1,35 @@
+"""Promote a recorded scaling.json into SCALING.md.
+
+The scaling ladder can come from two places: the TPU loop stage (writes
+SCALING.md itself via run_scaling) or the CPU fallback run
+(``--out results/scaling_cpu --no-md`` so it cannot clobber a better run's
+table). If the session ends with only the fallback recorded, this promotes
+it: ``python scripts/promote_scaling.py results/scaling_cpu/scaling.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from run_scaling import _write_md  # noqa: E402
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        raise SystemExit("usage: promote_scaling.py <path/to/scaling.json>")
+    with open(argv[0]) as f:
+        data = json.load(f)
+    # run_scaling stores runs keyed by str(count); _write_md sorts keys, so
+    # rebuild with int keys to keep 4 < 16 < 64 ordering
+    study = {int(k): v for k, v in data["runs"].items()}
+    _write_md(data["meta"], study)
+    print(f"SCALING.md <- {argv[0]}")
+
+
+if __name__ == "__main__":
+    main()

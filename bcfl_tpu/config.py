@@ -95,6 +95,12 @@ class FedConfig:
     # --- experiment identity ---
     name: str = "fed"
     seed: int = 42  # reference seeds dataset shuffle with 42 (server_IID_IMDB.py:68)
+    # typed-key PRNG implementation: None = jax's default (threefry).
+    # "rbg" opts into the TPU hardware generator — dropout RNG is +38% of
+    # step time under threefry (PERF.md). Both are deterministic given the
+    # seed, but they are DIFFERENT streams: changing this mid-experiment is
+    # like changing the seed (checkpoints record it; resume verifies).
+    prng_impl: Optional[str] = None
 
     # --- data ---
     dataset: str = "synthetic"  # key into bcfl_tpu.data.datasets registry
@@ -201,6 +207,9 @@ class FedConfig:
             raise ValueError("num_clients and num_rounds must be >= 1")
         if self.task not in ("classification", "causal_lm"):
             raise ValueError(f"unknown task: {self.task!r}")
+        if self.prng_impl not in (None, "threefry", "rbg"):
+            raise ValueError(
+                f"prng_impl must be None/threefry/rbg, got {self.prng_impl!r}")
         for field in ("param_dtype", "compute_dtype"):
             if getattr(self, field) not in ("float32", "bfloat16", "float16"):
                 raise ValueError(

@@ -64,6 +64,12 @@ def main(argv=None):
                          "stay dense)")
     ap.add_argument("--compute-dtype", default=None,
                     choices=["float32", "bfloat16", "float16"])
+    ap.add_argument("--prng-impl", default=None,
+                    choices=["threefry", "rbg"],
+                    help="typed-key PRNG: rbg = TPU hardware generator "
+                         "(dropout RNG is +38%% of step time under the "
+                         "threefry default; a different deterministic "
+                         "stream, like changing the seed)")
     ap.add_argument("--param-dtype", default=None,
                     choices=["float32", "bfloat16", "float16"])
     ap.add_argument("--faithful", action="store_true",
@@ -98,6 +104,7 @@ def main(argv=None):
         "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp", "sp": "sp",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
         "compute_dtype": "compute_dtype", "param_dtype": "param_dtype",
+        "prng_impl": "prng_impl",
     }
     overrides = {}
     for arg_name, cfg_name in simple.items():

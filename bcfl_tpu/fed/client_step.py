@@ -111,9 +111,12 @@ def make_loss_fn(model, task: str = "classification") -> Callable:
     raise ValueError(f"unknown task {task!r}")
 
 
-def _unstack_rng(r):
-    # rngs arrive as stacked key-data uint32 [..., 2]; rebuild typed keys
-    return jax.random.wrap_key_data(r)
+def _unstack_rng(r, impl=None):
+    # rngs arrive as stacked key-data uint32 [..., K] (threefry K=2,
+    # rbg K=4); rebuild typed keys. impl=None follows jax's default —
+    # passing an explicit impl makes the programs independent of the
+    # process-global config (FedConfig.prng_impl).
+    return jax.random.wrap_key_data(r, impl=impl)
 
 
 def make_eval_one(loss_fn) -> Callable:
@@ -230,6 +233,10 @@ def build_programs(
     gossip_alpha: float = 0.5,
     gossip_steps: int = 1,
     task: str = "classification",
+    # typed-key impl for the stacked per-client rngs: None follows jax's
+    # process default; "rbg" opts into the TPU hardware generator
+    # (dropout RNG is +38% of step time under threefry, PERF.md)
+    prng_impl: Optional[str] = None,
     # donate=True deletes the caller's input param/opt buffers after each call
     # (halves peak HBM for the round-chained engine); leave False if you reuse
     # the input tree afterwards.
@@ -251,7 +258,8 @@ def build_programs(
         return _build_programs_gspmd(
             model, mesh, optimizer=optimizer, learning_rate=learning_rate,
             max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
-            gossip_steps=gossip_steps, donate=donate, task=task)
+            gossip_steps=gossip_steps, donate=donate, task=task,
+            prng_impl=prng_impl)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
     if getattr(mesh, "tp", 1) > 1:
@@ -262,6 +270,7 @@ def build_programs(
             "or set it to 'gspmd' when tp > 1)")
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model, task)
+    unstack = lambda r: _unstack_rng(r, prng_impl)  # noqa: E731
     axis = mesh.axis
     jmesh = mesh.mesh
     repl = P()
@@ -275,7 +284,7 @@ def build_programs(
     # the scanned multi-round fast path below both apply exactly this body
     def server_shard(global_t, frozen, batches, weights, rngs):
         def per_client(b, r):
-            return local_train(global_t, frozen, b, _unstack_rng(r))
+            return local_train(global_t, frozen, b, unstack(r))
 
         new_t, stats = jax.vmap(per_client)(batches, rngs)
         # all-masked round -> keep the round's starting params, don't zero them
@@ -307,7 +316,7 @@ def build_programs(
 
     def gossip_shard(client_t, frozen, batches, mask, rngs):
         def per_client(t, b, r):
-            return local_train(t, frozen, b, _unstack_rng(r))
+            return local_train(t, frozen, b, unstack(r))
 
         new_t, stats = jax.vmap(per_client)(client_t, batches, rngs)
         return _mix(new_t, mask, fallback=client_t), stats
@@ -408,7 +417,7 @@ def build_programs(
     # ---- split-phase programs (ledger commit/verify flow, async engine) ----
     def client_updates_shard(global_t, frozen, batches, rngs):
         new_t, stats = jax.vmap(
-            lambda b, r: local_train(global_t, frozen, b, _unstack_rng(r))
+            lambda b, r: local_train(global_t, frozen, b, unstack(r))
         )(batches, rngs)
         return new_t, stats
 
@@ -423,7 +432,7 @@ def build_programs(
 
     def local_updates_shard(client_t, frozen, batches, rngs):
         return jax.vmap(
-            lambda t, b, r: local_train(t, frozen, b, _unstack_rng(r))
+            lambda t, b, r: local_train(t, frozen, b, unstack(r))
         )(client_t, batches, rngs)
 
     local_updates = jax.jit(
@@ -525,6 +534,7 @@ def _build_programs_gspmd(
     gossip_steps: int = 1,
     donate: bool = False,
     task: str = "classification",
+    prng_impl: Optional[str] = None,
 ) -> FedPrograms:
     """GSPMD twin of the shard_map builder: identical program signatures and
     semantics (global stacked-client arrays in, global arrays out), but the
@@ -533,6 +543,7 @@ def _build_programs_gspmd(
     all-reduce / collective-permute (:mod:`bcfl_tpu.parallel.gspmd`)."""
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model, task)
+    unstack = lambda r: _unstack_rng(r, prng_impl)  # noqa: E731
     local_train = make_local_train(tx, loss_fn)
     jmesh = mesh.mesh
     cl = NamedSharding(jmesh, P(mesh.axis))
@@ -548,7 +559,7 @@ def _build_programs_gspmd(
     # every client trains from the same replicated trainable
     def train_clients(global_t, frozen, batches, rngs):
         new_t, stats = jax.vmap(
-            lambda b, r: local_train(global_t, frozen, b, _unstack_rng(r))
+            lambda b, r: local_train(global_t, frozen, b, unstack(r))
         )(batches, rngs)
         return _c(new_t, cl), _c(stats, cl)
 
@@ -601,7 +612,7 @@ def _build_programs_gspmd(
     # each client trains from its OWN stacked params
     def local_updates_body(client_t, frozen, batches, rngs):
         new_t, stats = jax.vmap(
-            lambda t, b, r: local_train(t, frozen, b, _unstack_rng(r))
+            lambda t, b, r: local_train(t, frozen, b, unstack(r))
         )(client_t, batches, rngs)
         return _c(new_t, cl), _c(stats, cl)
 

@@ -94,7 +94,12 @@ class FedEngine:
     ):
         self.cfg = cfg
         self.tamper_hook = tamper_hook
-        self.root_key = jax.random.key(cfg.seed)
+        self.root_key = jax.random.key(cfg.seed, impl=cfg.prng_impl)
+        # RESOLVED key impl, as key-data width (threefry=2, rbg=4): with
+        # prng_impl=None the run follows jax's process default, which env
+        # vars can change — checkpoints must record what actually ran, not
+        # the config field
+        self._prng_code = int(jax.random.key_data(self.root_key).shape[-1])
 
         # --- data (tokenize once; SURVEY.md §3.2 fixes the 200x re-tokenize) ---
         self.dataset = load_dataset(
@@ -207,6 +212,7 @@ class FedEngine:
             gossip_alpha=cfg.topology.gossip_alpha,
             gossip_steps=cfg.topology.gossip_steps,
             task=cfg.task,
+            prng_impl=cfg.prng_impl,
         )
         # Pin the global trees to their steady-state shardings NOW: the round
         # programs return replicated trees, so a single-device-committed
@@ -387,6 +393,13 @@ class FedEngine:
             if restored is not None:
                 start_round, state, ledger_json = restored
                 start_round += 1
+                ck_impl = state.get("prng_impl_code")
+                if ck_impl is not None and int(ck_impl) != self._prng_code:
+                    raise ValueError(
+                        f"checkpoint prng key width {int(ck_impl)} != this "
+                        f"run's {self._prng_code} "
+                        f"(prng_impl={cfg.prng_impl!r}): resuming would "
+                        "change the RNG stream")
                 ck_seed = state.get("seed")
                 if ck_seed is not None and int(ck_seed) != cfg.seed:
                     raise ValueError(
@@ -521,8 +534,11 @@ class FedEngine:
             "trainable": jax.device_get(trainable),
             "stacked": jax.device_get(stacked) if stacked is not None else None,
             # the RNG stream is derived deterministically from the seed +
-            # round; storing the seed lets resume verify it
+            # round + key impl; storing both lets resume verify them
             "seed": np.int64(cfg.seed),
+            # resolved key-data width (orbax trees hold arrays): threefry=2,
+            # rbg=4 — see __init__._prng_code
+            "prng_impl_code": np.int64(self._prng_code),
         }
         save_checkpoint(
             cfg.checkpoint_dir, rnd, state,

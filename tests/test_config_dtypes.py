@@ -61,6 +61,34 @@ def test_use_flash_on_forces_every_length():
     assert _engine(use_flash=True).model.cfg.flash_min_seq == 0
 
 
+def test_rbg_prng_end_to_end():
+    """FedConfig(prng_impl='rbg'): typed keys carry the impl through
+    fold/split/key_data/wrap across the whole engine round."""
+    import jax
+
+    eng = _engine(prng_impl="rbg", num_rounds=2)
+    import numpy as np
+
+    res = eng.run()
+    assert np.isfinite([r.train_loss for r in res.metrics.rounds]).all()
+    assert jax.random.key_data(eng.root_key).shape[-1] == 4  # rbg key width
+
+
+def test_resume_rejects_prng_impl_change(tmp_path):
+    from bcfl_tpu.entrypoints.run import run
+
+    base = dict(
+        name="prng_resume", model="tiny-bert", dataset="synthetic",
+        num_clients=2, num_rounds=1, seq_len=16, batch_size=4,
+        max_local_batches=1, checkpoint_dir=str(tmp_path),
+        checkpoint_every=1,
+        partition=PartitionConfig(kind="iid", iid_samples=8))
+    run(FedConfig(**base), verbose=False)
+    with pytest.raises(ValueError, match="prng"):
+        run(FedConfig(**{**base, "num_rounds": 2, "prng_impl": "rbg"}),
+            resume=True, verbose=False)
+
+
 def test_resume_does_not_override_configured_param_dtype(tmp_path):
     import jax
 

@@ -129,6 +129,10 @@ class FedConfig:
     # encoders: dense). True forces the O(S)-memory blockwise/Pallas
     # attention path — the long-context switch, reachable from the CLI
     use_flash: Optional[bool] = None
+    # per-layer activation rematerialization: recompute activations in the
+    # backward instead of storing them — O(num_layers) less activation HBM
+    # for ~1/3 more FLOPs, so more full-fine-tune clients stack per chip
+    remat: bool = False
 
     # --- scale-out (SURVEY.md §2.5: the two axes the reference lacks) ---
     # tensor-parallel shards per client: tp > 1 builds a 2-D (clients, tp)

@@ -126,6 +126,8 @@ class FedEngine:
         # a config that says float32 compute must not silently train bf16
         dtype_overrides = {"dtype": jnp.dtype(cfg.compute_dtype),
                            "param_dtype": jnp.dtype(cfg.param_dtype)}
+        if cfg.remat:
+            dtype_overrides["remat"] = True
         if cfg.use_flash is not None:
             dtype_overrides["use_flash"] = cfg.use_flash
             if cfg.use_flash:

@@ -59,6 +59,9 @@ def main(argv=None):
                     "0.025 chance rate and measured noise)")
     ap.add_argument("--eval-batches", type=int, default=16)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override FedConfig.seed (seed-repeat runs "
+                    "quantify run-to-run noise for the trend claim)")
     ap.add_argument("--out", default="results")
     ap.add_argument("--no-md", action="store_true",
                     help="write <out>/scaling.json + curves but do NOT "
@@ -96,6 +99,7 @@ def main(argv=None):
             partition=PartitionConfig(
                 kind="iid", iid_samples=args.iid_samples,
                 resample_each_round=True),
+            **({"seed": args.seed} if args.seed is not None else {}),
         )
         print(f"\n===== {name} =====", flush=True)
         t0 = time.time()

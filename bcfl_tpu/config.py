@@ -133,6 +133,17 @@ class FedConfig:
     # backward instead of storing them — O(num_layers) less activation HBM
     # for ~1/3 more FLOPs, so more full-fine-tune clients stack per chip
     remat: bool = False
+    # donate each round's input param/opt buffers to the round program:
+    # XLA aliases them into the outputs, halving per-round peak HBM (the
+    # difference between 10 x BERT-base full fine-tune fitting a 16 GB chip
+    # or not). The engine chains carries, so semantics are unchanged; the
+    # one restriction is that engine.run() is single-shot (round 1 consumes
+    # the initial tree) — a second run() raises instead of recomputing.
+    # Scope: the sync server/gossip round programs (per-round and fused,
+    # incl. the fused ledger *_fp path). The async/faithful paths and the
+    # per-round split-phase ledger flow run undonated programs — there the
+    # flag is a warning-emitting no-op.
+    donate: bool = False
 
     # --- scale-out (SURVEY.md §2.5: the two axes the reference lacks) ---
     # tensor-parallel shards per client: tp > 1 builds a 2-D (clients, tp)

@@ -64,6 +64,10 @@ def main(argv=None):
                          "stay dense)")
     ap.add_argument("--compute-dtype", default=None,
                     choices=["float32", "bfloat16", "float16"])
+    ap.add_argument("--donate", action="store_true",
+                    help="donate each round's input param/opt buffers to "
+                         "the round program: half the per-round peak HBM "
+                         "(one run per engine)")
     ap.add_argument("--remat", action="store_true",
                     help="per-layer activation rematerialization: less HBM "
                          "per client (more clients stack per chip) for "
@@ -127,6 +131,8 @@ def main(argv=None):
         overrides["use_flash"] = args.use_flash == "on"
     if args.remat:
         overrides["remat"] = True
+    if args.donate:
+        overrides["donate"] = True
     if args.faithful:
         overrides["faithful"] = True
     if args.anomaly_filter is not None:

@@ -215,7 +215,15 @@ class FedEngine:
             gossip_steps=cfg.topology.gossip_steps,
             task=cfg.task,
             prng_impl=cfg.prng_impl,
+            donate=cfg.donate,
         )
+        if cfg.donate and (cfg.sync == "async" or cfg.faithful):
+            import warnings
+
+            warnings.warn(
+                "donate=True has no effect on the async/faithful paths — "
+                "they run only undonated split-phase programs, so peak HBM "
+                "is unchanged", stacklevel=2)
         # Pin the global trees to their steady-state shardings NOW: the round
         # programs return replicated trees, so a single-device-committed
         # trainable0 would make round 2's input sharding differ from round
@@ -426,6 +434,18 @@ class FedEngine:
                 if ledger_json and self.ledger is not None:
                     self.ledger = Ledger.from_json(
                         ledger_json, cfg.ledger.use_native)
+
+        # single-shot guard AFTER the restore branch: resume supplies a
+        # fresh trainable, so a donated-away trainable0 only matters when
+        # it is actually the tree this run will consume
+        if (cfg.donate and trainable is self.trainable0
+                and any(getattr(x, "is_deleted", lambda: False)()
+                        for x in jax.tree.leaves(self.trainable0))):
+            raise RuntimeError(
+                "engine.run() is single-shot with donate=True: round 1 "
+                "donated the initial trainable buffers to the round "
+                "program. Build a fresh FedEngine (or resume from a "
+                "checkpoint, or set donate=False) to run again.")
 
         if cfg.mode == "serverless" and not cfg.faithful and stacked is None:
             stacked = self.progs.broadcast(trainable)

@@ -26,7 +26,9 @@ import numpy as np
 
 
 def model_size_gb(tree) -> float:
-    return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree)) / 1e9
+    # metadata-only: np.asarray would pull every leaf to host (a full-tree
+    # device transfer per call) and crashes on donated-away buffers
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree)) / 1e9
 
 
 class ResourceMonitor:

@@ -222,9 +222,10 @@ class FedConfig:
             raise ValueError("num_clients and num_rounds must be >= 1")
         if self.task not in ("classification", "causal_lm"):
             raise ValueError(f"unknown task: {self.task!r}")
-        if self.prng_impl not in (None, "threefry", "rbg"):
+        if self.prng_impl not in (None, "threefry", "rbg", "unsafe_rbg"):
             raise ValueError(
-                f"prng_impl must be None/threefry/rbg, got {self.prng_impl!r}")
+                "prng_impl must be None/threefry/rbg/unsafe_rbg, "
+                f"got {self.prng_impl!r}")
         for field in ("param_dtype", "compute_dtype"):
             if getattr(self, field) not in ("float32", "bfloat16", "float16"):
                 raise ValueError(

@@ -241,9 +241,13 @@ def _medical(
         return _synthetic(num_labels=num_labels, name="medical_transcriptions")
     tr_t, tr_raw = _read_raw_csv(tr, "description", "medical_specialty")
     te_t, te_raw = _read_raw_csv(te, "description", "medical_specialty")
-    tr_y, _, _ = _map_labels(tr_raw)
-    te_y, _, _ = _map_labels(te_raw)
-    n = int(max(tr_y.max(), te_y.max())) + 1
+    # ONE lut over train+test: mapping the two splits independently would
+    # silently mis-join their label spaces for string-labeled variants (the
+    # shipped MT CSVs carry ints, where either way coincides — but the
+    # reference maps specialty STRINGS, server_iid_medical_transcirptions
+    # .py:56,68, and a user's own CSV may too)
+    labels, n, _ = _map_labels(list(tr_raw) + list(te_raw))
+    tr_y, te_y = labels[:len(tr_t)], labels[len(tr_t):]
     return TextDataset("medical_transcriptions", tr_t, tr_y, te_t, te_y, max(n, num_labels))
 
 

@@ -73,11 +73,13 @@ def main(argv=None):
                          "per client (more clients stack per chip) for "
                          "~1/3 more FLOPs")
     ap.add_argument("--prng-impl", default=None,
-                    choices=["threefry", "rbg"],
+                    choices=["threefry", "rbg", "unsafe_rbg"],
                     help="typed-key PRNG: rbg = TPU hardware generator "
                          "(dropout RNG is +38%% of step time under the "
                          "threefry default; a different deterministic "
-                         "stream, like changing the seed)")
+                         "stream, like changing the seed); unsafe_rbg "
+                         "trades cross-version reproducibility for the "
+                         "fastest fold/split path")
     ap.add_argument("--param-dtype", default=None,
                     choices=["float32", "bfloat16", "float16"])
     ap.add_argument("--faithful", action="store_true",

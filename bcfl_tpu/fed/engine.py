@@ -91,15 +91,27 @@ class FedEngine:
         cfg: FedConfig,
         tamper_hook: Optional[Callable] = None,
         info_source: int = 1,
+        fused_tamper: Optional[Callable] = None,
     ):
         self.cfg = cfg
         self.tamper_hook = tamper_hook
+        # fused-mode transport corruption: ``fused_tamper(rnd) -> [C] float
+        # scales or None`` perturbs the round's updates INSIDE the fused
+        # dispatch, after ledger commit and before aggregation (the in-graph
+        # simulated-transport stage, client_step._fp_auth). Unlike
+        # ``tamper_hook`` (host-tree byte tampering, forces the per-round
+        # path) it composes with fusion — it exists to prove fused-mode auth
+        # can actually fail.
+        self.fused_tamper = fused_tamper
         self.root_key = jax.random.key(cfg.seed, impl=cfg.prng_impl)
-        # RESOLVED key impl, as key-data width (threefry=2, rbg=4): with
-        # prng_impl=None the run follows jax's process default, which env
-        # vars can change — checkpoints must record what actually ran, not
-        # the config field
+        # RESOLVED key impl: with prng_impl=None the run follows jax's
+        # process default, which env vars can change — checkpoints must
+        # record what actually ran, not the config field. The NAME is the
+        # real identity (two different impls can share a key-data width,
+        # e.g. rbg vs unsafe_rbg are both 4); the width stays recorded for
+        # checkpoints written before the name existed
         self._prng_code = int(jax.random.key_data(self.root_key).shape[-1])
+        self._prng_name = str(jax.random.key_impl(self.root_key))
 
         # --- data (tokenize once; SURVEY.md §3.2 fixes the 200x re-tokenize) ---
         self.dataset = load_dataset(
@@ -403,6 +415,18 @@ class FedEngine:
             if restored is not None:
                 start_round, state, ledger_json = restored
                 start_round += 1
+                ck_name = state.get("prng_impl_name")
+                if ck_name is not None:
+                    ck_name = bytes(np.asarray(ck_name, np.uint8)).decode()
+                    if ck_name != self._prng_name:
+                        raise ValueError(
+                            f"checkpoint prng impl {ck_name!r} != this run's "
+                            f"{self._prng_name!r} "
+                            f"(prng_impl={cfg.prng_impl!r}): resuming would "
+                            "change the RNG stream")
+                # width-only fallback for checkpoints that predate the name
+                # field (cannot distinguish same-width impls, e.g. rbg vs
+                # unsafe_rbg — the name check above exists for exactly that)
                 ck_impl = state.get("prng_impl_code")
                 if ck_impl is not None and int(ck_impl) != self._prng_code:
                     raise ValueError(
@@ -474,6 +498,20 @@ class FedEngine:
                         on_round(r)
                 rnd += chunk
                 continue
+
+            if (self.fused_tamper is not None
+                    and self.fused_tamper(rnd) is not None):
+                # the transport-corruption stage only exists inside the fused
+                # *_fp programs: silently dropping a requested corruption on
+                # a per-round-path round would let a verification test pass
+                # vacuously (auth all-ones because nothing was corrupted)
+                raise ValueError(
+                    f"fused_tamper requests corruption for round {rnd}, but "
+                    "this round runs the per-round path (chunk=1: check "
+                    "rounds_per_dispatch, eval/checkpoint boundaries, and "
+                    "_chunk_rounds eligibility) — the corruption would be "
+                    "silently ignored; use tamper_hook for per-round "
+                    "tampering")
 
             t0 = time.time()
             with clock.phase("control_plane"):
@@ -561,6 +599,10 @@ class FedEngine:
             # resolved key-data width (orbax trees hold arrays): threefry=2,
             # rbg=4 — see __init__._prng_code
             "prng_impl_code": np.int64(self._prng_code),
+            # resolved impl NAME, uint8-encoded (orbax trees hold arrays):
+            # distinguishes same-width impls (rbg vs unsafe_rbg)
+            "prng_impl_name": np.frombuffer(
+                self._prng_name.encode(), np.uint8).copy(),
         }
         save_checkpoint(
             cfg.checkpoint_dir, rnd, state,
@@ -575,14 +617,17 @@ class FedEngine:
         Eligible only when the host has nothing to do between rounds: sync
         server FedAvg or sync parallel serverless gossip (NOT the faithful
         host-sequential mode), no anomaly filter (the mask is all-ones), no
-        tamper hook. The LEDGER no longer blocks fusion: the fused ``*_fp``
-        programs emit each round's per-client update fingerprints in-graph,
-        and in a fused dispatch the aggregated buffer IS the committed one
-        (no transport between commit and aggregation), so auth-gating the
-        mean is an identity — semantics are unchanged. A tamper hook (or the
-        shard_map impl, which has no fp programs) falls back to per-round.
-        Chunks never cross an eval or checkpoint boundary, so the observable
-        cadence is identical to the per-round path."""
+        host tamper hook. The LEDGER no longer blocks fusion: the fused
+        ``*_fp`` programs commit each round's per-client fingerprints
+        in-graph BEFORE a simulated-transport stage, re-fingerprint the
+        transported buffer AFTER it, gate the aggregation by the in-graph
+        comparison, and the host chain authenticates the post-transport
+        fingerprints — so fused-mode auth genuinely fails for a corrupted
+        update (``fused_tamper``) instead of being an identity. A host
+        tamper hook (or the shard_map impl, which has no fp programs) falls
+        back to per-round. Chunks never cross an eval or checkpoint
+        boundary, so the observable cadence is identical to the per-round
+        path."""
         cfg = self.cfg
         k = cfg.rounds_per_dispatch
         ledger_blocks = (self.ledger is not None
@@ -621,18 +666,33 @@ class FedEngine:
             jax.tree.map(lambda *xs: jnp.stack(xs), *batch_list))
         return False, rbatches, rrngs, n_ex_list
 
-    def _commit_chunk_fps(self, rnd: int, k: int, fps, recs) -> None:
-        """Fused-mode ledger commit: each round's per-client update
-        fingerprints were computed in-graph ([k, C, K]); chain them all
-        after the dispatch and stamp the (identity, see ``_chunk_rounds``)
-        auth masks on the records."""
-        fps = np.asarray(fps)  # blocks on the fused dispatch: round_program
+    def _commit_chunk_fps(self, rnd: int, k: int, fps_commit, fps_recv,
+                          recs) -> None:
+        """Fused-mode ledger flow: chain each round's PRE-transport commit
+        fingerprints ([k, C, K], computed in-graph before the simulated
+        transport stage), then authenticate the POST-transport fingerprints
+        against the chain. The two trees differ whenever transport corrupted
+        an update (``fused_tamper``), so this auth can genuinely fail — and
+        the in-graph aggregation already excluded exactly those clients."""
+        fps_commit = np.asarray(fps_commit)  # blocks on the fused dispatch
+        fps_recv = np.asarray(fps_recv)
         with self.clock.phase("ledger"):
             for i in range(k):
-                self._ledger_commit_rows(rnd + i, "stacked", fps[i])
+                self._ledger_commit_rows(rnd + i, "stacked", fps_commit[i])
             for i, rec in enumerate(recs):
                 rec.auth = self._ledger_auth_rows(
-                    rnd + i, "stacked", fps[i]).tolist()
+                    rnd + i, "stacked", fps_recv[i]).tolist()
+
+    def _chunk_corrupts(self, rnd: int, k: int):
+        """[k, C] transport-corruption scales for the fused fp programs
+        (zeros = clean; see ``fused_tamper`` in ``__init__``)."""
+        corr = np.zeros((k, self.cfg.num_clients), np.float32)
+        if self.fused_tamper is not None:
+            for i in range(k):
+                row = self.fused_tamper(rnd + i)
+                if row is not None:
+                    corr[i] = np.asarray(row, np.float32)
+        return self.mesh.shard_round_clients(jnp.asarray(corr))
 
     def _server_chunk(self, rnd: int, trainable, k: int):
         """Run rounds [rnd, rnd+k) in ONE XLA dispatch via server_rounds."""
@@ -645,11 +705,12 @@ class FedEngine:
         if self.ledger is not None:
             prog = (self.progs.server_rounds_static_fp if static
                     else self.progs.server_rounds_fp)
-            trainable, (stats, fps) = prog(trainable, self.frozen, batches,
-                                           rweights, rrngs)
+            trainable, (stats, fpc, fpr, _auth) = prog(
+                trainable, self.frozen, batches, rweights, rrngs,
+                self._chunk_corrupts(rnd, k))
             stats = np.asarray(stats)
             recs = [self._stats_to_rec(rnd + i, stats[i]) for i in range(k)]
-            self._commit_chunk_fps(rnd, k, fps, recs)
+            self._commit_chunk_fps(rnd, k, fpc, fpr, recs)
             return trainable, recs
         prog = (self.progs.server_rounds_static if static
                 else self.progs.server_rounds)
@@ -675,8 +736,10 @@ class FedEngine:
         if self.ledger is not None:
             prog = (self.progs.gossip_rounds_static_fp if static
                     else self.progs.gossip_rounds_fp)
-            stacked, (stats, fps) = prog(stacked, self.frozen, batches,
-                                         masks, rrngs)
+            stacked, (stats, fpc, fpr, _auth) = prog(
+                stacked, self.frozen, batches, masks, rrngs,
+                self._chunk_corrupts(rnd, k))
+            fps = (fpc, fpr)
         else:
             prog = (self.progs.gossip_rounds_static if static
                     else self.progs.gossip_rounds)
@@ -699,12 +762,15 @@ class FedEngine:
         stats = np.asarray(stats)  # [k, C, 3]
         recs = [self._stats_to_rec(rnd + i, stats[i]) for i in range(k)]
         if fps is not None:
-            self._commit_chunk_fps(rnd, k, fps, recs)
+            self._commit_chunk_fps(rnd, k, fps[0], fps[1], recs)
         return stacked, consensus, recs
 
     def _annotate_chunk(self, recs, wall: float) -> None:
         """Participation/info-passing fields for fused rounds (all-ones mask
-        by construction; wall time split evenly across the chunk)."""
+        by construction). The measured unit is the CHUNK: ``wall_chunk_s``
+        carries the real dispatch wall time, ``wall_s`` its even split
+        across the chunk's rounds, and ``fused=True`` marks both as
+        chunk-derived so consumers can tell interpolated from measured."""
         C = self.cfg.num_clients
         sync_t, async_t = self.graph.info_passing_time(
             self._payload_gb() if self.ledger is None
@@ -715,6 +781,8 @@ class FedEngine:
             rec.anomalies = []
             rec.info_passing_sync_s = sync_t
             rec.info_passing_async_s = async_t
+            rec.fused = True
+            rec.wall_chunk_s = wall
             rec.wall_s = wall / max(len(recs), 1)
 
     # ----------------------------------------------------------- round bodies

@@ -26,9 +26,17 @@ import numpy as np
 
 
 def model_size_gb(tree) -> float:
-    # metadata-only: np.asarray would pull every leaf to host (a full-tree
-    # device transfer per call) and crashes on donated-away buffers
-    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree)) / 1e9
+    # metadata-only on array leaves: np.asarray would pull every leaf to host
+    # (a full-tree device transfer per call) and crashes on donated-away
+    # buffers. Non-array leaves (plain ints/floats in a host-side state dict)
+    # fall back to np.asarray — those are already on host, so the transfer
+    # concern doesn't apply.
+    def leaf_bytes(x):
+        if hasattr(x, "size") and hasattr(x, "dtype"):
+            return x.size * x.dtype.itemsize
+        return np.asarray(x).nbytes
+
+    return sum(leaf_bytes(x) for x in jax.tree.leaves(tree)) / 1e9
 
 
 class ResourceMonitor:
@@ -70,6 +78,12 @@ class RoundRecord:
     info_passing_sync_s: Optional[float] = None
     info_passing_async_s: Optional[float] = None
     wall_s: float = 0.0
+    # True when this round ran inside a fused multi-round dispatch: wall_s
+    # is then the chunk total split EVENLY across its rounds (an
+    # interpolation, not a per-round measurement — the real measured unit is
+    # wall_chunk_s) and info-passing values are chunk-constant
+    fused: bool = False
+    wall_chunk_s: Optional[float] = None
 
 
 @dataclasses.dataclass

@@ -65,14 +65,28 @@ def gossip_mix(tree: Tree, mask: jnp.ndarray, alpha: float,
                steps: int = 1) -> Tree:
     """Symmetric masked ring gossip over the global client order — same
     update rule (and anomaly-freeze semantics) as
-    ``collectives.gossip_mix``."""
+    ``collectives.gossip_mix``. The self==received special case of
+    :func:`gossip_mix_recv` (one mixing-rule definition, not two)."""
+    return gossip_mix_recv(tree, tree, mask, alpha, steps=steps)
+
+
+def gossip_mix_recv(self_tree: Tree, recv_tree: Tree, mask: jnp.ndarray,
+                    alpha: float, steps: int = 1) -> Tree:
+    """``gossip_mix`` with distinct SELF and RECEIVED trees: each client's
+    self-term comes from ``self_tree`` (its local, honest state) while the
+    neighbor terms are ring-shifted from ``recv_tree`` (the transported
+    copies, which a corrupted link may have perturbed — the fused-ledger
+    verification path). With ``recv_tree`` value-equal to ``self_tree``
+    this is bit-identical to ``gossip_mix``. Only the FIRST step models
+    transport (later steps exchange post-mix state, whose transport is not
+    simulated)."""
     from bcfl_tpu.parallel.collectives import gossip_step_mix
 
     m_left = jnp.roll(mask, 1, axis=0)   # value of client i-1, at slot i
     m_right = jnp.roll(mask, -1, axis=0)
     for _ in range(steps):
-        left = ring_shift(tree, direction=-1)
-        right = ring_shift(tree, direction=+1)
+        left = ring_shift(recv_tree, direction=-1)
+        right = ring_shift(recv_tree, direction=+1)
 
         def mix(x, xl, xr):
             ml = m_left.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
@@ -80,8 +94,9 @@ def gossip_mix(tree: Tree, mask: jnp.ndarray, alpha: float,
             me = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
             return gossip_step_mix(x, xl, xr, ml, mr, me, alpha)
 
-        tree = jax.tree.map(mix, tree, left, right)
-    return tree
+        self_tree = jax.tree.map(mix, self_tree, left, right)
+        recv_tree = self_tree
+    return self_tree
 
 
 def mix_with_matrix(tree: Tree, W: jnp.ndarray) -> Tree:

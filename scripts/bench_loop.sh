@@ -6,8 +6,8 @@
 # round 3's evidence. This loop instead probes cheaply every PERIOD seconds
 # and fires the heavy jobs only in a healthy window, in stages:
 #
-#   A. headline GSPMD bench, recompile-free   -> results/bench_r04_fixed.json
-#   B. serverless-mode bench                  -> results/bench_r04_serverless.json
+#   A. headline GSPMD bench, recompile-free   -> results/bench_r05_fixed.json
+#   B. serverless-mode bench                  -> results/bench_r05_serverless.json
 #   0. dispatch-gap bisect (diagnostic, after the benches — a healthy
 #      window may be short; falls through)    -> results/dispatch_bisect_tpu.json
 #   C. tpu_perf.py kernel + dispatch sweep    -> PERF.md (+ tpu_perf_done)
@@ -18,7 +18,7 @@
 # All child invocations use `timeout -k` (a wedged init ignores SIGTERM).
 set -u
 cd /root/repo
-LOG=results/bench_r04_attempts.log
+LOG=results/bench_r05_attempts.log
 PERIOD=${BENCH_LOOP_PERIOD:-900}
 
 say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
@@ -50,8 +50,8 @@ run_bench() {  # $1 = mode, $2 = out file, [$3 = extra env "K=V"]
 while true; do
   if { [ -f results/dispatch_bisect_tpu.json ] \
        || [ -f results/dispatch_bisect_failed ]; } \
-     && [ -f results/bench_r04_fixed.json ] \
-     && [ -f results/bench_r04_serverless.json ] \
+     && [ -f results/bench_r05_fixed.json ] \
+     && [ -f results/bench_r05_serverless.json ] \
      && [ -f results/tpu_perf_done ] \
      && [ -f results/scaling_tpu_done ] \
      && [ -f results/modes_smallbert_done ]; then
@@ -64,11 +64,11 @@ while true; do
     # the headline bench FIRST: a healthy window may be short, and the
     # recorded >=5x number is the round's one must-do (VERDICT r3 #1);
     # diagnostics run only once the benches are on disk
-    if [ ! -f results/bench_r04_fixed.json ]; then
-      run_bench server results/bench_r04_fixed.json || { sleep "$PERIOD"; continue; }
+    if [ ! -f results/bench_r05_fixed.json ]; then
+      run_bench server results/bench_r05_fixed.json || { sleep "$PERIOD"; continue; }
     fi
-    if [ ! -f results/bench_r04_serverless.json ]; then
-      run_bench serverless results/bench_r04_serverless.json || { sleep "$PERIOD"; continue; }
+    if [ ! -f results/bench_r05_serverless.json ]; then
+      run_bench serverless results/bench_r05_serverless.json || { sleep "$PERIOD"; continue; }
     fi
     if [ ! -f results/dispatch_bisect_tpu.json ] \
        && [ ! -f results/dispatch_bisect_failed ]; then
@@ -90,15 +90,15 @@ while true; do
     fi
     # bonus row: the TPU hardware PRNG (dropout RNG is +38% of step time
     # under threefry, PERF.md); recorded separately, never the headline
-    if [ ! -f results/bench_r04_rbg.json ]; then
-      run_bench server results/bench_r04_rbg.json BCFL_BENCH_PRNG=rbg \
+    if [ ! -f results/bench_r05_rbg.json ]; then
+      run_bench server results/bench_r05_rbg.json BCFL_BENCH_PRNG=rbg \
         || say "rbg bonus bench failed (non-gating)"
     fi
     if [ ! -f results/tpu_perf_done ]; then
       say "running tpu_perf sweep"
       if timeout -k 10 14400 python scripts/tpu_perf.py \
            --trace-dir results/perf_trace \
-           >> results/tpu_perf_r04.log 2>&1; then
+           >> results/tpu_perf_r05.log 2>&1; then
         touch results/tpu_perf_done
         say "tpu_perf done -> PERF.md"
       else

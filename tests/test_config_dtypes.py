@@ -106,3 +106,22 @@ def test_resume_does_not_override_configured_param_dtype(tmp_path):
               resume=True, verbose=False)
     assert all(x.dtype == jnp.bfloat16
                for x in jax.tree.leaves(res.trainable))
+
+
+def test_resume_rejects_same_width_prng_impl_change(tmp_path):
+    """ADVICE r04: the checkpoint records the resolved impl NAME, not just
+    the key-data width — rbg and unsafe_rbg share width 4, so a width-only
+    guard would silently resume across a different RNG stream."""
+    from bcfl_tpu.entrypoints.run import run
+
+    base = dict(
+        name="prng_name_resume", model="tiny-bert", dataset="synthetic",
+        num_clients=2, num_rounds=1, seq_len=16, batch_size=4,
+        max_local_batches=1, checkpoint_dir=str(tmp_path),
+        checkpoint_every=1, prng_impl="rbg",
+        partition=PartitionConfig(kind="iid", iid_samples=8))
+    run(FedConfig(**base), verbose=False)
+    with pytest.raises(ValueError, match="prng impl"):
+        run(FedConfig(**{**base, "num_rounds": 2,
+                         "prng_impl": "unsafe_rbg"}),
+            resume=True, verbose=False)

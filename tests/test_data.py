@@ -155,3 +155,29 @@ def test_map_labels_float_column_guard():
         _map_labels(np.array([0.0, np.nan]))
     with pytest.raises(ValueError, match="non-integral"):
         _map_labels(np.array([0.5, 1.0]))
+
+
+def test_medical_string_labels_share_one_lut(tmp_path):
+    """VERDICT r04 weak #4: _medical must map train and test label columns
+    through ONE shared lut — independently-sorted maps would silently
+    mis-join the splits' label spaces for string specialties (the reference
+    maps specialty strings: server_iid_medical_transcirptions.py:56,68)."""
+    import pandas as pd
+
+    # train sees 3 specialties, test only the LAST one alphabetically — an
+    # independent per-split map would give it index 0 instead of 2
+    pd.DataFrame({
+        "description": [f"note {i}" for i in range(6)],
+        "medical_specialty": ["cardiology", "cardiology", "neurology",
+                              "neurology", "urology", "urology"],
+    }).to_csv(tmp_path / "train_file_mt.csv", index=False)
+    pd.DataFrame({
+        "description": ["followup a", "followup b"],
+        "medical_specialty": ["urology", "urology"],
+    }).to_csv(tmp_path / "test_file_mt.csv", index=False)
+
+    ds = load_dataset("medical_transcriptions", data_dir=str(tmp_path),
+                      num_labels=0)
+    np.testing.assert_array_equal(ds.train_labels, [0, 0, 1, 1, 2, 2])
+    np.testing.assert_array_equal(ds.test_labels, [2, 2])
+    assert ds.num_labels == 3

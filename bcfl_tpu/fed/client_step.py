@@ -254,6 +254,64 @@ def build_programs(
 ) -> FedPrograms:
     if impl == "auto":
         impl = os.environ.get("BCFL_FED_IMPL", "gspmd")
+    # Program memoization: flax modules and jax Meshes hash/compare by VALUE
+    # (module config dataclasses, mesh devices + axis names), so two engines
+    # over equal configs get the SAME jitted program objects — and with them
+    # XLA's compile cache. Sweeps (run_results, scaling ladders) and the test
+    # suite re-create engines constantly; without this every one recompiles
+    # every program (~half the r04 suite's 36 minutes). Unhashable inputs
+    # (e.g. an sp-injected attention closure compares by identity) just skip
+    # the cache — never wrong, only cold.
+    try:
+        # ClientMesh is a frozen dataclass: hashing the instance covers every
+        # mesh field, including any added later that changes program layout
+        key = (model, mesh, optimizer, learning_rate, max_grad_norm,
+               gossip_alpha, gossip_steps, task, prng_impl, donate, impl)
+        hash(key)
+    except TypeError:
+        key = None
+    if os.environ.get("BCFL_PROGRAM_CACHE", "1") == "0":  # debug kill-switch
+        key = None
+    if key is not None and key in _PROGRAM_CACHE:
+        return _PROGRAM_CACHE[key]
+    progs = _build_programs_dispatch(
+        model, mesh, optimizer=optimizer, learning_rate=learning_rate,
+        max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
+        gossip_steps=gossip_steps, donate=donate, task=task,
+        prng_impl=prng_impl, impl=impl)
+    if key is not None:
+        while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            # FIFO eviction bounds the compiled-executable footprint over a
+            # long sweep; live engines keep their own references, so an
+            # evicted entry frees only once no engine uses it
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        _PROGRAM_CACHE[key] = progs
+    return progs
+
+
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 32
+
+
+def clear_program_cache() -> None:
+    """Drop all memoized program sets (their compiled executables free once
+    no live engine references them)."""
+    _PROGRAM_CACHE.clear()
+
+
+def _build_programs_dispatch(
+    model,
+    mesh: ClientMesh,
+    optimizer: str,
+    learning_rate: float,
+    max_grad_norm: float,
+    gossip_alpha: float,
+    gossip_steps: int,
+    task: str,
+    prng_impl: Optional[str],
+    donate: bool,
+    impl: str,
+) -> FedPrograms:
     if impl == "gspmd":
         return _build_programs_gspmd(
             model, mesh, optimizer=optimizer, learning_rate=learning_rate,

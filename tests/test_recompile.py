@@ -17,6 +17,15 @@ from bcfl_tpu.config import FedConfig, PartitionConfig
 from bcfl_tpu.fed.engine import FedEngine
 
 
+@pytest.fixture(autouse=True)
+def _fresh_programs(monkeypatch):
+    """These tests count jit cache entries PER ENGINE; the cross-engine
+    program cache deliberately accumulates one entry per tree structure on
+    shared objects (e.g. lora adapters after full params), which is correct
+    behavior but not what this regression pins. Disable sharing here."""
+    monkeypatch.setenv("BCFL_PROGRAM_CACHE", "0")
+
+
 def _run(mode, **kw):
     cfg = FedConfig(
         name=f"recompile_{mode}", model="tiny-bert", dataset="synthetic",

@@ -204,6 +204,8 @@ class FedConfig:
     # --- checkpoint / metrics ---
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # rounds; 0 = off
+    # evaluate every Nth round (the final round always evaluates);
+    # 0 = never evaluate, INCLUDING the final round (pure-throughput runs)
     eval_every: int = 1
     # cap the central-eval set to this many batches (None = the full test
     # split, the reference's evaluate_global_model behaviour); small hosts
@@ -220,6 +222,10 @@ class FedConfig:
             raise ValueError(f"unknown sync: {self.sync!r}")
         if self.num_clients < 1 or self.num_rounds < 1:
             raise ValueError("num_clients and num_rounds must be >= 1")
+        if self.eval_every < 0:
+            # 0 = never evaluate (pure-throughput runs); negative cadences
+            # would silently produce modulo surprises
+            raise ValueError(f"eval_every must be >= 0, got {self.eval_every}")
         if self.task not in ("classification", "causal_lm"):
             raise ValueError(f"unknown task: {self.task!r}")
         if self.prng_impl not in (None, "threefry", "rbg", "unsafe_rbg"):

@@ -56,6 +56,12 @@ def main(argv=None):
                     help="span the mesh over every host in the pod "
                          "(jax.distributed must be initialized; see "
                          "core.mesh.distributed_init)")
+    ap.add_argument("--eval-every", type=int, default=None,
+                    help="evaluate every Nth round (per-round eval caps "
+                         "fused dispatches at 1 round and dominates wall on "
+                         "slow hosts; the final round always evaluates). "
+                         "0 disables evaluation entirely — including the "
+                         "final round (pure-throughput runs)")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--use-flash", choices=["on", "off"], default=None,
                     help="force the O(S)-memory blockwise/Pallas attention "
@@ -87,6 +93,16 @@ def main(argv=None):
     ap.add_argument("--anomaly-filter",
                     choices=["pagerank", "dbscan", "zscore", "community", "none"],
                     default=None)
+    ap.add_argument("--fused-tamper", action="append", default=None,
+                    metavar="ROUND:CLIENT:SCALE",
+                    help="inject a simulated transport corruption (additive "
+                         "SCALE) into CLIENT's update in fused round ROUND "
+                         "(repeatable). The corrupted update fails ledger "
+                         "auth and is excluded from the aggregate — the "
+                         "BC-FL tamper-resistance demo. Needs --ledger and "
+                         "a fused dispatch (--rounds-per-dispatch > 1); a "
+                         "request landing on a per-round-path round fails "
+                         "loudly instead of being ignored")
     ap.add_argument("--ledger", action="store_true",
                     help="enable the hash-chained weight ledger (BC-FL)")
     ap.add_argument("--checkpoint-dir", default=None)
@@ -112,6 +128,7 @@ def main(argv=None):
         "lr": "learning_rate", "lora_rank": "lora_rank",
         "max_local_batches": "max_local_batches", "seed": "seed",
         "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp", "sp": "sp",
+        "eval_every": "eval_every",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",
         "compute_dtype": "compute_dtype", "param_dtype": "param_dtype",
         "prng_impl": "prng_impl",
@@ -146,10 +163,53 @@ def main(argv=None):
         overrides["pod"] = True
     cfg = cfg.replace(**overrides)
 
+    fused_tamper = None
+    if args.fused_tamper:
+        import numpy as np
+
+        if not cfg.ledger.enabled:
+            # without the ledger the engine runs the non-fp programs, which
+            # have no transport stage — the corruption would be silently
+            # dropped and the demo would pass vacuously
+            raise SystemExit("--fused-tamper needs --ledger (the transport-"
+                             "verification stage lives in the ledger's "
+                             "fused fingerprint programs)")
+        spec = {}
+        for s in args.fused_tamper:
+            try:
+                r, c, scale = s.split(":")
+                r, c, scale = int(r), int(c), float(scale)
+            except ValueError:
+                raise SystemExit(
+                    f"--fused-tamper {s!r}: expected ROUND:CLIENT:SCALE")
+            if not 0 <= c < cfg.num_clients:
+                raise SystemExit(
+                    f"--fused-tamper {s!r}: client out of range "
+                    f"[0, {cfg.num_clients})")
+            if not 0 <= r < cfg.num_rounds:
+                # rounds are 0-indexed; a never-reached round would make the
+                # demo pass vacuously (no corruption, all auth 1.0)
+                raise SystemExit(
+                    f"--fused-tamper {s!r}: round out of range "
+                    f"[0, {cfg.num_rounds}) (rounds are 0-indexed)")
+            spec.setdefault(r, []).append((c, scale))
+
+        def fused_tamper(rnd, _spec=spec, _n=cfg.num_clients):
+            rows = _spec.get(rnd)
+            if not rows:
+                return None
+            row = np.zeros((_n,), np.float32)
+            for c, scale in rows:
+                row[c] = scale
+            return row
+
     if args.sweep:
+        if fused_tamper is not None:
+            raise SystemExit("--fused-tamper does not compose with --sweep "
+                             "(client indices change per sweep point)")
         run_sweep(cfg, resume=args.resume)
     else:
-        run(cfg, resume=args.resume)
+        run(cfg, resume=args.resume, fused_tamper=fused_tamper)
 
 
 if __name__ == "__main__":

@@ -15,10 +15,14 @@ from bcfl_tpu.config import FedConfig
 from bcfl_tpu.fed.engine import FedEngine, RunResult
 
 
-def run(cfg: FedConfig, resume: bool = False, verbose: bool = True) -> RunResult:
+def run(cfg: FedConfig, resume: bool = False, verbose: bool = True,
+        fused_tamper=None) -> RunResult:
+    """``fused_tamper``: optional ``(round) -> [num_clients] float scales or
+    None`` — in-graph transport corruption for fused dispatches (the BC-FL
+    tamper-resistance demo; see ``FedEngine.__init__``)."""
     if verbose:
         print("\n".join(_header(cfg)), flush=True)
-    engine = FedEngine(cfg)
+    engine = FedEngine(cfg, fused_tamper=fused_tamper)
     result = engine.run(resume=resume,
                         on_round=_print_round if verbose else None)
     if verbose:
@@ -37,8 +41,14 @@ def _header(cfg: FedConfig) -> list:
 def _round_line(r) -> str:
     acc = f" global_acc={r.global_acc:.4f}" if r.global_acc is not None else ""
     anom = f" anomalies={r.anomalies}" if r.anomalies else ""
+    # surface ledger rejections: a tampered/corrupted update failing auth is
+    # the BC-FL flow's observable outcome and must not be silent
+    rejected = ([i for i, a in enumerate(r.auth) if a == 0.0]
+                if r.auth else [])
+    rej = f" auth_failed={rejected}" if rejected else ""
     return (f"round {r.round:3d}: train_loss={r.train_loss:.4f} "
-            f"train_acc={r.train_acc:.4f}{acc}{anom} wall={r.wall_s:.2f}s")
+            f"train_acc={r.train_acc:.4f}{acc}{anom}{rej} "
+            f"wall={r.wall_s:.2f}s")
 
 
 def _print_round(r) -> None:

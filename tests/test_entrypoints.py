@@ -105,3 +105,55 @@ def test_run_sweep_records_artifacts(tmp_path):
     assert figs == ["sweeptest_sweep_accuracy.png",
                     "sweeptest_sweep_latency.png",
                     "sweeptest_sweep_memory.png"]
+
+
+def test_cli_fused_tamper_demo(capsys):
+    """--fused-tamper R:C:SCALE drives the in-graph transport-corruption
+    demo end-to-end from the CLI: the corrupted client fails ledger auth in
+    that round (and only there), everyone else passes."""
+    import numpy as np
+
+    from bcfl_tpu.entrypoints.__main__ import main as cli_main
+    from bcfl_tpu.fed import engine as engine_mod
+
+    recorded = {}
+    orig_run = engine_mod.FedEngine.run
+
+    def spy_run(self, *a, **kw):
+        res = orig_run(self, *a, **kw)
+        recorded["rounds"] = res.metrics.rounds
+        return res
+
+    engine_mod.FedEngine.run = spy_run
+    try:
+        cli_main(["--preset", "smoke", "--mode", "server", "--rounds", "2",
+                  "--rounds-per-dispatch", "2", "--eval-every", "2",
+                  "--ledger", "--fused-tamper", "1:0:1e6"])
+    finally:
+        engine_mod.FedEngine.run = orig_run
+    rounds = recorded["rounds"]
+    C = len(rounds[0].auth)
+    assert rounds[0].auth == [1.0] * C
+    assert rounds[1].auth == [0.0] + [1.0] * (C - 1)
+
+
+def test_cli_fused_tamper_bad_spec():
+    from bcfl_tpu.entrypoints.__main__ import main as cli_main
+
+    with pytest.raises(SystemExit, match="ROUND:CLIENT:SCALE"):
+        cli_main(["--preset", "smoke", "--ledger",
+                  "--fused-tamper", "nonsense"])
+    with pytest.raises(SystemExit, match="client out of range"):
+        cli_main(["--preset", "smoke", "--clients", "2", "--ledger",
+                  "--fused-tamper", "0:5:1.0"])
+
+
+def test_cli_fused_tamper_requires_ledger_and_valid_round():
+    from bcfl_tpu.entrypoints.__main__ import main as cli_main
+
+    with pytest.raises(SystemExit, match="ledger"):
+        cli_main(["--preset", "smoke", "--rounds-per-dispatch", "2",
+                  "--fused-tamper", "0:0:1.0"])
+    with pytest.raises(SystemExit, match="round out of range"):
+        cli_main(["--preset", "smoke", "--rounds", "2", "--ledger",
+                  "--fused-tamper", "2:0:1.0"])

@@ -177,3 +177,31 @@ def test_server_rounds_scan_matches_sequential():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
     np.testing.assert_allclose(np.asarray(stats), np.stack(seq_stats),
                                rtol=2e-5, atol=1e-4)
+
+
+def test_program_cache_shares_and_evicts():
+    """Equal (model, mesh, scalars) build_programs calls return the SAME
+    FedPrograms (cross-engine jit reuse); the cache is FIFO-bounded and
+    clear_program_cache() empties it."""
+    from bcfl_tpu.core.mesh import client_mesh
+    from bcfl_tpu.fed import client_step as cs
+    from bcfl_tpu.models import build
+
+    cs.clear_program_cache()
+    mesh = client_mesh(2)
+    m = build("tiny-bert", num_labels=2)
+    p1 = cs.build_programs(m, mesh)
+    p2 = cs.build_programs(build("tiny-bert", num_labels=2),
+                           client_mesh(2))
+    assert p1 is p2
+    # a differing scalar is a different program set
+    p3 = cs.build_programs(m, mesh, learning_rate=1e-3)
+    assert p3 is not p1
+    # FIFO bound: filling past the cap evicts the oldest entry
+    n0 = len(cs._PROGRAM_CACHE)
+    for i in range(cs._PROGRAM_CACHE_MAX - n0 + 1):
+        cs.build_programs(m, mesh, learning_rate=2e-3 + i * 1e-6)
+    assert len(cs._PROGRAM_CACHE) == cs._PROGRAM_CACHE_MAX
+    assert cs.build_programs(m, mesh) is not p1  # p1 was evicted (oldest)
+    cs.clear_program_cache()
+    assert not cs._PROGRAM_CACHE

@@ -1,0 +1,20 @@
+"""Host-environment knobs shared by the CPU-mesh drivers (scripts/)."""
+
+from __future__ import annotations
+
+import os
+
+
+def raise_cpu_collective_timeouts() -> None:
+    """Raise XLA's CPU collective-rendezvous timeouts BEFORE backend init.
+
+    On a CPU mesh the collective rendezvous aborts the whole process if any
+    device thread lags >40s behind the others (rendezvous.cc terminate
+    timeout) — easily hit on a shared/loaded 1-core host where 8 device
+    threads compete through a multi-round scan. No-op if the caller already
+    set the terminate flag (idempotent, and respects explicit tuning)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "collective_call_terminate" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+            " --xla_cpu_collective_call_terminate_timeout_seconds=1200")

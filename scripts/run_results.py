@@ -94,16 +94,9 @@ def main(argv=None):
         _render(args, summary, accuracy_curves)
         return
 
-    # On a CPU mesh the XLA collective rendezvous aborts the whole process if
-    # any device thread lags >40s behind the others (rendezvous.cc terminate
-    # timeout) — easily hit on a shared/loaded host where 5+ device threads
-    # compete for cores through a 20-round scan. Raise both timeouts BEFORE
-    # the backend initializes.
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "collective_call_terminate" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-            " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    from bcfl_tpu.core.hostenv import raise_cpu_collective_timeouts
+
+    raise_cpu_collective_timeouts()
 
     if args.platform:
         import jax

@@ -1,0 +1,77 @@
+#!/bin/bash
+# Sequential CPU evidence queue: fires each stage as the previous finishes,
+# so the single core stays busy unattended (the TPU evidence loop runs
+# separately and only probes every few minutes).
+#
+#   1. wait for the in-flight run_results modes pair (server vs serverless
+#      small-bert -> RESULTS.md)
+#   2. worker-count ordering pair (5 vs 20 workers at small-bert)
+#   3. ledger-overhead re-measure (the fused path gained a second
+#      fingerprint pass for transport verification — PERF.md's 0.03%
+#      figure needs re-recording)
+#   4. full test suite -> results/suite_r05_final.log
+#
+# Stage gates are .done markers written ONLY on success (worker_pair's
+# data JSON is written incrementally, so its existence alone cannot gate;
+# the script itself resumes per-count from a partial JSON). A flock on
+# the script path prevents two queue instances racing the same stages.
+set -u
+cd /root/repo
+LOG=results/session_queue.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+exec 9< "$0"
+if ! flock -n 9; then
+  echo "another session_queue instance holds the lock; exiting" >&2
+  exit 1
+fi
+
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export JAX_PLATFORMS=cpu
+
+say "queue start; waiting for modes pair"
+while pgrep -f "run_results.py --model small-bert" > /dev/null; do
+  sleep 120
+done
+say "modes pair done (or not running)"
+
+if [ ! -f results/worker_pair_done ]; then
+  say "worker pair start"
+  if nice -n 19 timeout -k 30 14400 python scripts/worker_pair.py \
+       --platform cpu >> results/worker_pair.log 2>&1; then
+    touch results/worker_pair_done
+    say "worker pair done"
+  else
+    say "worker pair failed/timed out (partial JSON resumes per-count)"
+  fi
+fi
+
+if [ ! -f results/ledger_overhead_r05.json ]; then
+  say "ledger overhead re-measure start"
+  if nice -n 19 timeout -k 30 7200 python scripts/ledger_overhead.py \
+       --platform cpu --fused > results/ledger_overhead_r05.out 2>&1; then
+    # the script rewrites results/ledger_overhead.json; keep an r05 copy so
+    # the pre-verification figure stays in history
+    cp results/ledger_overhead.json results/ledger_overhead_r05.json
+    say "ledger overhead done"
+  else
+    say "ledger overhead failed/timed out"
+  fi
+fi
+
+if [ ! -f results/suite_r05_final.log ]; then
+  say "full suite start"
+  nice -n 19 timeout -k 30 14400 python -m pytest tests/ -q \
+    > results/suite_r05_final.partial 2>&1
+  rc=$?
+  if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
+    # rc 0 = green, rc 1 = ran to completion with failures — both are real
+    # evidence; only a timeout kill must NOT be gated as a finished suite
+    mv results/suite_r05_final.partial results/suite_r05_final.log
+    say "full suite done (rc=$rc): $(tail -1 results/suite_r05_final.log)"
+  else
+    say "full suite TIMED OUT (rc=$rc); partial kept at .partial, stage not gated"
+  fi
+fi
+
+say "queue done"

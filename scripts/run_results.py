@@ -32,7 +32,8 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
 REFERENCE = {  # BASELINE.md, Medical Transcriptions (BioBERT, 20 rounds)
     "server_iid_medical": {"final_acc": 0.68, "acc_10_workers": 0.672},
@@ -255,6 +256,78 @@ def _capacity_note(summary):
             "pretrained reference numbers.")
 
 
+def _mode_ordering_note(summary, out_dir):
+    """Derived (not asserted) serverless-vs-server ordering block: emitted
+    only when both medical configs exist at the SAME (model, rounds,
+    seq_len, clients, eval cap/cadence, hf) budget — the reference's
+    headline claims are orderings (README.md:10: serverless −5% latency /
+    +13% accuracy; MT nb cell 31: serverless-NonIID 73.6 vs server-IID 68
+    final), so the honest offline check is whether the SIGNS reproduce at
+    matched budgets. A merged summary can hold runs recorded under
+    different flags; comparing those would conflate budget with mode."""
+    sv = summary.get("server_iid_medical")
+    sl = summary.get("serverless_noniid_medical")
+    if not (sv and sl):
+        return ""
+    if any(sv.get(k) != sl.get(k)
+           for k in ("model", "rounds", "seq_len", "hf_weights", "clients",
+                     "max_eval_batches", "eval_every")):
+        return ""
+    if sv.get("final_acc") is None or sl.get("final_acc") is None:
+        return ""
+    lines = [
+        "## Mode ordering vs the reference's headline claims",
+        "",
+        f"Matched budget ({sv['model']}, {sv['clients']} clients, "
+        f"{sv['rounds']} rounds, seq {sv.get('seq_len')}):",
+        "",
+    ]
+    acc_gap = sl["final_acc"] - sv["final_acc"]
+    ref_line = ("reference: serverless-NonIID 0.736 vs server-IID 0.68 "
+                "final (MT nb cell 31), README.md:10 claims +13%")
+    sign = "REPRODUCES" if acc_gap > 0 else "does NOT reproduce"
+    lines.append(
+        f"- **Accuracy**: serverless {sl['final_acc']:.3f} vs server "
+        f"{sv['final_acc']:.3f} ({acc_gap:+.3f}) — the serverless>server "
+        f"sign {sign} here ({ref_line}).")
+    if sv.get("wall_minutes") and sl.get("wall_minutes"):
+        lat_gap = sl["wall_minutes"] - sv["wall_minutes"]
+        sign = "REPRODUCES" if lat_gap < 0 else "does NOT reproduce"
+        lines.append(
+            f"- **Latency**: serverless {sl['wall_minutes']:.1f} min vs "
+            f"server {sv['wall_minutes']:.1f} min ({lat_gap:+.1f}) — the "
+            f"serverless<server sign {sign} here (reference MT nb cell 15: "
+            "105/122/187 vs 280/628/810 min).")
+    wp_path = os.path.join(out_dir, "worker_pair_smallbert.json")
+    try:
+        with open(wp_path) as f:
+            wp = json.load(f)
+        runs = wp.get("runs", {})
+        if len(runs) >= 2:
+            counts = sorted(runs, key=int)
+            lo, hi = counts[0], counts[-1]
+            a_lo, a_hi = runs[lo].get("final_acc"), runs[hi].get("final_acc")
+            if a_lo is not None and a_hi is not None:
+                trend = a_hi - a_lo
+                sign = "rises" if trend > 0 else "is flat/falls"
+                # the pair has its OWN budget (worker count is the variable
+                # under test; its other knobs may differ from the rows
+                # above) — state it so the numbers aren't attributed to the
+                # header's budget
+                lines.append(
+                    f"- **Worker count** ({wp.get('model')}, serverless "
+                    f"IID, its own budget: {wp.get('rounds')} rounds, seq "
+                    f"{wp.get('seq_len')}): {lo} workers {a_lo:.3f} -> "
+                    f"{hi} workers {a_hi:.3f} ({trend:+.3f}) — accuracy "
+                    f"{sign} with worker count (reference MT nb cell 18 "
+                    "serverless: 0.75/0.758/0.775 for 5/10/20 — a +0.025 "
+                    "spread; results/worker_pair_smallbert.json).")
+    except (OSError, json.JSONDecodeError):
+        pass
+    lines.append("")
+    return "\n".join(lines)
+
+
 def _write_results_md(args, summary):
     ref = REFERENCE
     # provenance comes from the recorded summary (authoritative, and correct
@@ -352,6 +425,9 @@ def _write_results_md(args, summary):
         "`results/`).",
         "",
     ]
+    ordering = _mode_ordering_note(summary, args.out)
+    if ordering:
+        lines += [ordering, ""]
     bc = summary.get("bcfl_async_pagerank_medical")
     if bc:
         lines += [

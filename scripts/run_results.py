@@ -317,7 +317,8 @@ def _mode_ordering_note(summary, out_dir):
                 lines.append(
                     f"- **Worker count** ({wp.get('model')}, serverless "
                     f"IID, its own budget: {wp.get('rounds')} rounds, seq "
-                    f"{wp.get('seq_len')}): {lo} workers {a_lo:.3f} -> "
+                    f"{wp.get('seq_len')}, {wp.get('iid_samples')} "
+                    f"samples/worker/round): {lo} workers {a_lo:.3f} -> "
                     f"{hi} workers {a_hi:.3f} ({trend:+.3f}) — accuracy "
                     f"{sign} with worker count (reference MT nb cell 18 "
                     "serverless: 0.75/0.758/0.775 for 5/10/20 — a +0.025 "

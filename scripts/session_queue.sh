@@ -5,11 +5,14 @@
 #
 #   1. wait for the in-flight run_results modes pair (server vs serverless
 #      small-bert -> RESULTS.md)
-#   2. worker-count ordering pair (5 vs 20 workers at small-bert)
+#   2. full test suite -> results/suite_r05_final.log (the mandatory
+#      green-suite evidence comes before the bonus runs)
 #   3. ledger-overhead re-measure (the fused path gained a second
 #      fingerprint pass for transport verification — PERF.md's 0.03%
 #      figure needs re-recording)
-#   4. full test suite -> results/suite_r05_final.log
+#   4. worker-count ordering pair (5 vs 20 workers at small-bert, reduced
+#      per-worker budget so the 20-worker leg fits the session; resumable
+#      per count if cut short)
 #
 # Stage gates are .done markers written ONLY on success (worker_pair's
 # data JSON is written incrementally, so its existence alone cannot gate;
@@ -35,14 +38,18 @@ while pgrep -f "run_results.py --model small-bert" > /dev/null; do
 done
 say "modes pair done (or not running)"
 
-if [ ! -f results/worker_pair_done ]; then
-  say "worker pair start"
-  if nice -n 19 timeout -k 30 14400 python scripts/worker_pair.py \
-       --platform cpu >> results/worker_pair.log 2>&1; then
-    touch results/worker_pair_done
-    say "worker pair done"
+if [ ! -f results/suite_r05_final.log ]; then
+  say "full suite start"
+  nice -n 19 timeout -k 30 14400 python -m pytest tests/ -q \
+    > results/suite_r05_final.partial 2>&1
+  rc=$?
+  if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
+    # rc 0 = green, rc 1 = ran to completion with failures — both are real
+    # evidence; only a timeout kill must NOT be gated as a finished suite
+    mv results/suite_r05_final.partial results/suite_r05_final.log
+    say "full suite done (rc=$rc): $(tail -1 results/suite_r05_final.log)"
   else
-    say "worker pair failed/timed out (partial JSON resumes per-count)"
+    say "full suite TIMED OUT (rc=$rc); partial kept at .partial, stage not gated"
   fi
 fi
 
@@ -59,18 +66,15 @@ if [ ! -f results/ledger_overhead_r05.json ]; then
   fi
 fi
 
-if [ ! -f results/suite_r05_final.log ]; then
-  say "full suite start"
-  nice -n 19 timeout -k 30 14400 python -m pytest tests/ -q \
-    > results/suite_r05_final.partial 2>&1
-  rc=$?
-  if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
-    # rc 0 = green, rc 1 = ran to completion with failures — both are real
-    # evidence; only a timeout kill must NOT be gated as a finished suite
-    mv results/suite_r05_final.partial results/suite_r05_final.log
-    say "full suite done (rc=$rc): $(tail -1 results/suite_r05_final.log)"
+if [ ! -f results/worker_pair_done ]; then
+  say "worker pair start (reduced budget: 6 rounds, 250 samples/worker)"
+  if nice -n 19 timeout -k 30 14400 python scripts/worker_pair.py \
+       --platform cpu --rounds 6 --iid-samples 250 \
+       >> results/worker_pair.log 2>&1; then
+    touch results/worker_pair_done
+    say "worker pair done"
   else
-    say "full suite TIMED OUT (rc=$rc); partial kept at .partial, stage not gated"
+    say "worker pair failed/timed out (partial JSON resumes per-count)"
   fi
 fi
 

@@ -38,6 +38,14 @@ def main(argv=None):
     ap.add_argument("--model", default="small-bert")
     ap.add_argument("--seq-len", type=int, default=96)
     ap.add_argument("--eval-batches", type=int, default=24)
+    ap.add_argument("--iid-samples", type=int, default=0,
+                    help="per-worker IID draw per round (0 = the preset's "
+                         "500, the reference budget). More workers = more "
+                         "TOTAL data per round either way — the mechanism "
+                         "the reference's rising worker trend rides — so a "
+                         "reduced per-worker budget preserves the contrast "
+                         "under test on a slow host; the recorded JSON "
+                         "carries the value so RESULTS.md can disclose it")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "results",
                                                   "worker_pair_smallbert.json"))
@@ -58,6 +66,11 @@ def main(argv=None):
     base = get_preset("serverless_iid_medical").replace(
         model=args.model, num_rounds=args.rounds, eval_every=2,
         max_eval_batches=args.eval_batches, seq_len=args.seq_len)
+    if args.iid_samples:
+        import dataclasses
+
+        base = base.replace(partition=dataclasses.replace(
+            base.partition, iid_samples=args.iid_samples))
 
     record = {"model": args.model, "rounds": args.rounds,
               "seq_len": args.seq_len, "dataset": base.dataset,

@@ -8,15 +8,19 @@ IID worker contributes its own 100-sample draw per round, so more workers
 tiny-bert also saturated ~0.37 on this corpus while small-bert reached
 0.451 and was still climbing (RESULTS.md), i.e. the flatness is plausibly
 a capacity ceiling, not a federation property. This runs the END POINTS
-of the sweep (5 vs 20, the 4x data spread) at small-bert capacity, same
-per-worker budget, to test whether the reference's ordering appears once
-the model can absorb the extra data.
+of the sweep (5 vs 20, the 4x data spread) at small-bert capacity, the
+SAME per-worker budget for both counts (``--iid-samples`` can reduce it
+below the preset's 500 to fit a slow host — more workers still means
+proportionally more total data per round, the contrast under test; the
+recorded JSON carries the value so RESULTS.md discloses it), to test
+whether the reference's ordering appears once the model can absorb the
+extra data.
 
 Writes ``results/worker_pair_smallbert.json`` incrementally (the cheap
 5-worker leg lands even if the 20-worker leg is cut short).
 
 Usage: python scripts/worker_pair.py [--rounds 10] [--counts 5 20]
-           [--platform cpu]
+           [--iid-samples 250] [--platform cpu]
 """
 
 from __future__ import annotations
@@ -76,7 +80,9 @@ def main(argv=None):
               "seq_len": args.seq_len, "dataset": base.dataset,
               "iid_samples": base.partition.iid_samples, "runs": {}}
     # resumable: a prior partial JSON (e.g. the cheap leg landed, the long
-    # leg timed out) keeps its finished counts instead of re-paying them
+    # leg timed out) keeps its finished counts instead of re-paying them.
+    # A budget-mismatched partial is preserved to a timestamped .bak —
+    # those legs may be hours of compute and must never vanish silently.
     if os.path.exists(args.out):
         try:
             with open(args.out) as f:
@@ -84,6 +90,11 @@ def main(argv=None):
             if all(prev.get(k) == record[k] for k in
                    ("model", "rounds", "seq_len", "dataset", "iid_samples")):
                 record["runs"] = prev.get("runs", {})
+            elif prev.get("runs"):
+                bak = f"{args.out}.bak{int(time.time())}"
+                os.replace(args.out, bak)
+                print(f"prior {args.out} was recorded under a different "
+                      f"budget; preserved to {bak}", flush=True)
         except (OSError, json.JSONDecodeError, KeyError):
             pass
     for n in sorted(args.counts):  # cheap leg first: evidence lands early

@@ -54,6 +54,21 @@ if [ ! -f results/suite_r05_final.log ]; then
   fi
 fi
 
+if [ ! -f results/worker_pair_done ]; then
+  # 4 rounds x 250 samples/worker: the 20-worker leg's per-round compute
+  # matches the 10-client modes legs (~17 min/round measured), so this is
+  # what fits between the suite and session end; the JSON discloses it
+  say "worker pair start (reduced budget: 4 rounds, 250 samples/worker)"
+  if nice -n 19 timeout -k 30 14400 python scripts/worker_pair.py \
+       --platform cpu --rounds 4 --iid-samples 250 \
+       >> results/worker_pair.log 2>&1; then
+    touch results/worker_pair_done
+    say "worker pair done"
+  else
+    say "worker pair failed/timed out (partial JSON resumes per-count)"
+  fi
+fi
+
 if [ ! -f results/ledger_overhead_r05.json ]; then
   say "ledger overhead re-measure start"
   if nice -n 19 timeout -k 30 7200 python scripts/ledger_overhead.py \
@@ -64,18 +79,6 @@ if [ ! -f results/ledger_overhead_r05.json ]; then
     say "ledger overhead done"
   else
     say "ledger overhead failed/timed out"
-  fi
-fi
-
-if [ ! -f results/worker_pair_done ]; then
-  say "worker pair start (reduced budget: 6 rounds, 250 samples/worker)"
-  if nice -n 19 timeout -k 30 14400 python scripts/worker_pair.py \
-       --platform cpu --rounds 6 --iid-samples 250 \
-       >> results/worker_pair.log 2>&1; then
-    touch results/worker_pair_done
-    say "worker pair done"
-  else
-    say "worker pair failed/timed out (partial JSON resumes per-count)"
   fi
 fi
 

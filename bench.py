@@ -196,9 +196,19 @@ def main():
         ids0 = jnp.ones((2, SEQ), jnp.int32)
         # jitted init: unjitted flax init dispatches hundreds of host ops
         # (minutes over the tunnel)
+        # host readback of ONE scalar: the only real completion fence on the
+        # axon tunnel, where jax.block_until_ready no-ops on remote arrays
+        # (measured this session: 8 chained 4096^3 matmuls "block" in 3 ms,
+        # then a 1-element fetch waits 1.9 s for the real work). Eager-op
+        # cost (~3 tunnel RTTs) only ever lands in untimed stages.
+        def fence(tree):
+            jax.block_until_ready(tree)  # still correct off-tunnel
+            leaf = jax.tree.leaves(tree)[0]
+            return float(jnp.asarray(leaf).ravel()[0])
+
         params = jax.jit(
             lambda k: model.init(k, ids0, ids0)["params"])(jax.random.key(0))
-        jax.block_until_ready(params)
+        fence(params)
         # place params in the round program's steady-state (replicated)
         # sharding BEFORE the first call: a single-device-committed input
         # would compile once for that layout and then AGAIN when the chained
@@ -229,13 +239,25 @@ def main():
                     lambda x: jnp.broadcast_to(
                         x[None], (num_clients,) + x.shape), p),
                 out_shardings=mesh.client_sharding())(params)
-            jax.block_until_ready(carry)
+            fence(carry)
             run_block = lambda c: progs.gossip_rounds(  # noqa: E731
                 c, None, rbatches, rweights, rrngs)[0]
         else:
             carry = params
             run_block = lambda c: progs.server_rounds(  # noqa: E731
                 c, None, rbatches, rweights, rrngs)[0]
+
+        # timed-region fence: same host-readback idea as fence(), but through
+        # ONE pre-compiled program (a single tunnel RTT, negligible vs the
+        # multi-second dispatch it fences; the eager fence() would add ~3
+        # RTTs of per-op dispatch to the measurement). The warmup sync calls
+        # below compile it for the carry's steady-state sharding, outside
+        # the timed loop.
+        syncer = jax.jit(lambda l: l.ravel()[0].astype(jnp.float32))
+
+        def sync(c):
+            jax.block_until_ready(c)  # correct fence on non-tunnel backends
+            return float(syncer(jax.tree.leaves(c)[0]))
 
         # compile + TWO warmup dispatches under one deadline: even with the
         # input pre-placed, any residual input-sharding/layout drift between
@@ -249,9 +271,9 @@ def main():
         watchdog.stage("compile", max(STAGE_TIMEOUT_S,
                                       600.0 + 0.7 * ROUNDS * STEPS))
         carry = run_block(carry)
-        jax.block_until_ready(carry)
+        sync(carry)
         carry = run_block(carry)
-        jax.block_until_ready(carry)
+        sync(carry)
 
         watchdog.stage("measure")
         trace_dir = os.environ.get("BCFL_BENCH_TRACE")
@@ -260,7 +282,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(ITERS):
             carry = run_block(carry)
-        jax.block_until_ready(carry)
+        sync(carry)
         dt = time.perf_counter() - t0
         if trace_dir:
             jax.profiler.stop_trace()
@@ -282,6 +304,20 @@ def main():
             out["prng"] = prng
         if peak:
             out["mfu_pct"] = round(100.0 * flops / dt / (peak * n_dev), 2)
+        # a rate above peak silicon is not a measurement, it is a broken
+        # completion fence (this session's first run "measured" 332,370%
+        # MFU because block_until_ready no-ops on the tunnel). Checked on
+        # EVERY device kind — an unlisted chip falls back to the fastest
+        # known peak so a no-op fence can never emit a green line
+        implied_flops = flops / dt / n_dev
+        ceiling = peak if peak else max(PEAK_FLOPS.values())
+        if implied_flops > 1.2 * ceiling:
+            watchdog.cancel()
+            _error_json("measure", "implausible result (implied "
+                        f"{implied_flops / 1e12:.0f} TFLOP/s/chip > device "
+                        "peak): completion fence did not wait for device "
+                        "execution")
+            sys.exit(1)
         watchdog.cancel()
         _emit(out)
     except Exception as e:  # noqa: BLE001 — evidence must survive any failure

@@ -36,6 +36,7 @@ import numpy as np
 from bcfl_tpu.checkpoint import restore_latest, save_checkpoint
 from bcfl_tpu.config import FedConfig
 from bcfl_tpu.core import client_mesh, client_round_keys, pod_devices
+from bcfl_tpu.core.fence import fence
 from bcfl_tpu.data import (
     Partitioner,
     TokenCache,
@@ -375,8 +376,9 @@ class FedEngine:
         # just-dispatched client_updates/local_updates program completes
         # inside this phase's first blocking transfer and gets billed to
         # the ledger (observed: a "90% ledger" reading that was ~95%
-        # training wait)
-        jax.block_until_ready(stacked)
+        # training wait). Must be core.fence — on the tunnelled backend
+        # block_until_ready returns before the device finishes
+        fence(stacked)
         with self.clock.phase("ledger"):
             if self.tamper_hook is not None:
                 host = jax.device_get(stacked)
@@ -865,7 +867,7 @@ class FedEngine:
             shared, stats = self.progs.single_update(shared, self.frozen, cb, keys[c])
             if fp_mode:
                 # device-side digest: K floats cross the link, not the tree
-                jax.block_until_ready(shared)  # single_update is async
+                fence(shared)  # single_update is async; see _ledger_verify
                 with self.clock.phase("ledger"):
                     fp = np.asarray(self.progs.fingerprint_one(shared))
                     snap_fps.append(fp)

@@ -178,6 +178,7 @@ def main():
             jax.config.update("jax_default_prng_impl", prng)
         import jax.numpy as jnp
 
+        from bcfl_tpu.core.fence import fence
         from bcfl_tpu.core.mesh import client_mesh
         from bcfl_tpu.fed.client_step import build_programs
         from bcfl_tpu.fed.synthetic import synthetic_round_inputs
@@ -196,16 +197,10 @@ def main():
         ids0 = jnp.ones((2, SEQ), jnp.int32)
         # jitted init: unjitted flax init dispatches hundreds of host ops
         # (minutes over the tunnel)
-        # host readback of ONE scalar: the only real completion fence on the
-        # axon tunnel, where jax.block_until_ready no-ops on remote arrays
-        # (measured this session: 8 chained 4096^3 matmuls "block" in 3 ms,
-        # then a 1-element fetch waits 1.9 s for the real work). Eager-op
-        # cost (~3 tunnel RTTs) only ever lands in untimed stages.
-        def fence(tree):
-            jax.block_until_ready(tree)  # still correct off-tunnel
-            leaf = jax.tree.leaves(tree)[0]
-            return float(jnp.asarray(leaf).ravel()[0])
-
+        # untimed stages fence via core.fence (host readback — the only
+        # real completion fence on the axon tunnel, where
+        # jax.block_until_ready no-ops on remote arrays; its docstring has
+        # the measurement)
         params = jax.jit(
             lambda k: model.init(k, ids0, ids0)["params"])(jax.random.key(0))
         fence(params)
@@ -247,12 +242,12 @@ def main():
             run_block = lambda c: progs.server_rounds(  # noqa: E731
                 c, None, rbatches, rweights, rrngs)[0]
 
-        # timed-region fence: same host-readback idea as fence(), but through
-        # ONE pre-compiled program (a single tunnel RTT, negligible vs the
-        # multi-second dispatch it fences; the eager fence() would add ~3
-        # RTTs of per-op dispatch to the measurement). The warmup sync calls
-        # below compile it for the carry's steady-state sharding, outside
-        # the timed loop.
+        # timed-region fence: same host-readback idea as core.fence, but
+        # through ONE pre-compiled program (a single tunnel RTT, negligible
+        # vs the multi-second dispatch it fences; the eager core.fence would
+        # add ~3 RTTs of per-op dispatch to the measurement). The warmup
+        # sync calls below compile it for the carry's steady-state sharding,
+        # outside the timed loop.
         syncer = jax.jit(lambda l: l.ravel()[0].astype(jnp.float32))
 
         def sync(c):

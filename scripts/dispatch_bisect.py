@@ -81,7 +81,10 @@ def main(argv=None):
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
+
     from jax import lax
+
+    from bcfl_tpu.core.fence import fence  # block_until_ready no-ops on the tunnel
 
     from bcfl_tpu.core.mesh import client_mesh
     from bcfl_tpu.fed.client_step import (build_programs, make_local_train,
@@ -117,7 +120,7 @@ def main(argv=None):
     ids0 = jnp.ones((2, SEQ), jnp.int32)
     params = jax.jit(lambda k: model.init(k, ids0, ids0)["params"])(
         jax.random.key(0))
-    jax.block_until_ready(params)
+    fence(params)
 
     tx = make_optimizer("adamw", 5e-5)
     loss_fn = make_loss_fn(model)
@@ -148,18 +151,18 @@ def main(argv=None):
         wd.stage(f"compile:{name}")
         t0 = time.perf_counter()
         carry = fn(carry)
-        jax.block_until_ready(carry)
+        fence(carry)
         compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         carry = fn(carry)
-        jax.block_until_ready(carry)
+        fence(carry)
         compile2_s = time.perf_counter() - t0
         note = (note + f" compile2={compile2_s:.1f}s").strip()
         wd.stage(f"measure:{name}")
         t0 = time.perf_counter()
         for _ in range(ITERS):
             carry = fn(carry)
-        jax.block_until_ready(carry)
+        fence(carry)
         dt = (time.perf_counter() - t0) / ITERS
         record(name, steps_per_call, dt,
                note=(note + f" compile={compile_s:.1f}s").strip())

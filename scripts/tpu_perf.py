@@ -27,18 +27,46 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 
-def _time_call(fn, *args, iters=3, warmup=1):
-    """Returns (seconds_per_call, last_output) — the output is returned so
-    callers can reuse it (an extra dispatch over the tunnel costs seconds)."""
-    import jax
+def _time_call(fn, *args, iters=3, warmup=1, chain=False):
+    """Returns (seconds_per_call, warmup_output).
 
+    The WARMUP output (fn on the original args) is what callers reuse for
+    numerics checks — with ``chain=True`` the timed calls feed each output
+    back as the first argument (requires matching in/out shapes), so their
+    outputs are not fn(original args). Chaining makes each timed dispatch's
+    input depend on the previous result, which defeats any request-level
+    caching in the tunnel (PERF.md measurement hygiene).
+
+    Fences with core.fence: on the tunnelled backend block_until_ready
+    returns before the device finishes, which would time dispatch enqueue
+    only (bench.py "measured" 332,370% MFU that way)."""
+    from bcfl_tpu.core.fence import fence
+
+    if chain:
+        # warmup 1 compiles for the original (uncommitted) input layout,
+        # warmup 2 for the chained layout (the output's sharding/layout can
+        # be a different jit cache key — the r04 87.5 s/dispatch artifact);
+        # the timed loop then continues the chain, so no timed call is
+        # byte-identical to a previous request (tunnel cache) and none
+        # compiles
+        warmup = max(warmup, 2)
+    x = args[0] if args else None
+    first = None
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        out = fn(x, *args[1:]) if args else fn()
+        fence(out)
+        if first is None:
+            first = out  # fn on the ORIGINAL args — the numerics oracle
+        if chain and args:
+            x = out
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters, out
+        out = fn(x, *args[1:]) if args else fn()
+        if chain:
+            x = out
+    fence(out)
+    dt = (time.perf_counter() - t0) / iters
+    return dt, (first if first is not None else out)
 
 
 def bench_sweep(trace_dir=None, quick=False):
@@ -111,16 +139,20 @@ def attention_sweep(quick=False):
         try:
             jpf, jxf = jax.jit(pl_fwd), jax.jit(xla_fwd)
             jpb, jxb = jax.jit(pl_bwd), jax.jit(xla_bwd)
-            tf, of = _time_call(jpf, q)
-            txf, oxf = _time_call(jxf, q)
-            tb, ob = _time_call(jpb, q)
-            txb, oxb = _time_call(jxb, q)
+            # chain=True: attention in/out shapes match, so each timed call
+            # consumes the previous output (outputs stay bounded — softmax
+            # convex combinations of v; grads keep the same FLOP count)
+            tf, of = _time_call(jpf, q, chain=True)
+            txf, oxf = _time_call(jxf, q, chain=True)
+            tb, ob = _time_call(jpb, q, chain=True)
+            txb, oxb = _time_call(jxb, q, chain=True)
             row = {"seq": S, "pallas_fwd_ms": tf * 1e3,
                    "xla_fwd_ms": txf * 1e3, "pallas_bwd_ms": tb * 1e3,
                    "xla_bwd_ms": txb * 1e3}
             # on-device numerics vs the XLA oracle, in f32, reusing the
-            # timed outputs (each extra dispatch costs seconds over the
-            # tunnel). Tolerance is relative to the oracle's max magnitude
+            # WARMUP outputs (fn on the original q; the chained timed
+            # outputs diverge by design — see _time_call). Tolerance is
+            # relative to the oracle's max magnitude
             # (bf16 carries ~3 decimal digits at any scale); the 1e-6 floor
             # only guards the degenerate all-zero oracle.
             f32 = jnp.float32
@@ -138,7 +170,7 @@ def attention_sweep(quick=False):
                 bias = causal_bias(jnp.ones((B, S), jnp.int32))
                 td, _ = _time_call(
                     jax.jit(lambda q: dot_product_attention(q, q, q, bias)),
-                    q)
+                    q, chain=True)
                 row["dense_fwd_ms"] = td * 1e3
         except Exception as e:  # noqa: BLE001 — evidence must survive
             row = {"seq": S, "error": f"{type(e).__name__}: {e}"}

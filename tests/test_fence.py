@@ -1,0 +1,67 @@
+"""core.fence: the real completion fence for tunnelled backends.
+
+On CPU the readback is trivially correct; these tests pin the API contract
+(arbitrary trees: params, PRNG keys, empty, sharded) so the engine/bench
+call sites can rely on it everywhere block_until_ready used to be.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bcfl_tpu.core.fence import fence
+from bcfl_tpu.core.mesh import client_mesh
+
+
+def test_fence_param_tree():
+    tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,), jnp.bfloat16)}
+    assert fence(tree) is None
+
+
+def test_fence_scalar_and_empty():
+    fence(jnp.float32(3.0))
+    fence({})
+    fence(None)
+    fence({"n": 3, "s": "host"})  # host-only leaves
+
+
+def test_fence_key_tree():
+    keys = jax.random.split(jax.random.key(0), 4)
+    fence({"k": keys})
+
+
+def test_fence_int_and_bool():
+    fence(jnp.arange(3))
+    fence(jnp.arange(3) > 1)
+
+
+def test_fence_zero_size_leaf():
+    fence(jnp.zeros((0, 4)))
+    # an empty FIRST leaf must not satisfy the fence (a 0-byte fetch waits
+    # for nothing); the readback has to fall through to a non-empty leaf
+    fence({"a": jnp.zeros((0,)), "b": jax.jit(lambda: jnp.ones((8, 8)))()})
+
+
+def test_fence_complex_dtype():
+    fence(jnp.ones((4,), jnp.complex64))
+
+
+def test_fence_skips_host_leaves():
+    # a host numpy leaf must not satisfy the fence — the readback has to
+    # target a device (jax.Array) leaf
+    import numpy as np
+
+    fence({"step": np.asarray(3), "params": jax.jit(lambda: jnp.ones(4))()})
+
+
+def test_fence_sharded_output():
+    mesh = client_mesh(8)
+    x = jax.device_put(jnp.arange(8.0), mesh.client_sharding())
+    y = jax.jit(lambda a: a * 2)(x)
+    fence(y)
+
+
+def test_fence_after_jit_matches_value():
+    y = jax.jit(lambda a: a + 1)(jnp.arange(4))
+    fence(y)
+    assert int(y[0]) == 1

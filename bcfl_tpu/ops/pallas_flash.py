@@ -86,7 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
     q = q_ref[0, 0]  # [bq, D]
     k = _zero_oob_rows(k_ref[0, 0], ki * bk, sk)  # [bk, D]
     v = _zero_oob_rows(v_ref[0, 0], ki * bk, sk)  # [bk, D]
-    b = bias_ref[0]  # [bk]
+    b = bias_ref[0, 0]  # [bk]
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
@@ -127,16 +127,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
         lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
+def _block_sizes(block_q: int, block_k: int, S: int, Sk: int):
+    """Clamp requested block sizes to shapes real-TPU Mosaic accepts: the
+    last two dims of every block must divide (8, 128) or equal the array
+    dims. bq tiles a sublane-adjacent dim (multiple of 8); bk tiles the
+    bias lane dim (multiple of 128). A caller's odd block size becomes the
+    nearest legal one instead of an obscure lowering error on silicon."""
+
+    def legal(b, dim, unit):
+        b = min(b, dim)
+        if b == dim or b % unit == 0:
+            return b
+        b = (b // unit) * unit
+        # floor hit zero: the nearest legal block is one tile — or the
+        # whole (smaller-than-a-tile) dim, which is pad-free AND legal
+        return b if b >= unit else min(unit, dim)
+
+    return legal(block_q, S, 8), legal(block_k, Sk, LANES)
+
+
 def _flash_fwd_pallas(q, k, v, key_bias, causal: bool,
                       block_q: int, block_k: int):
     """Returns ``(out [B,H,S,D], lse [B,H,S,LANES] f32)``."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
-    bq = min(block_q, S)
-    bk = min(block_k, Sk)
+    bq, bk = _block_sizes(block_q, block_k, S, Sk)
     grid = (B, H, pl.cdiv(S, bq), pl.cdiv(Sk, bk))
     scale = 1.0 / (D ** 0.5)
 
+    key_bias = key_bias[:, None, :]  # [B, 1, Sk] — see bias BlockSpec note
     return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, sq=S, sk=Sk),
@@ -145,7 +164,11 @@ def _flash_fwd_pallas(q, k, v, key_bias, causal: bool,
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, bk), lambda b, h, qi, ki: (b, ki)),
+            # [B, 1, Sk] with block (1, 1, bk): real-TPU Mosaic requires the
+            # last two block dims to divide (8, 128) or EQUAL the array dims
+            # — a (1, bk) block on [B, Sk] fails that for B > 1 (caught on
+            # silicon; interpret mode never checks it)
+            pl.BlockSpec((1, 1, bk), lambda b, h, qi, ki: (b, 0, ki)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -186,7 +209,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
     v = v_ref[0, 0]    # [bk, D]
     o = _zero_oob_rows(o_ref[0, 0], qi * bq, sq)    # [bq, D]
     do = _zero_oob_rows(do_ref[0, 0], qi * bq, sq)  # [bq, D]
-    b = bias_ref[0]    # [bk]
+    b = bias_ref[0, 0]  # [bk]
     lse = lse_ref[0, 0][:, :1]  # [bq, 1]
 
     s = jax.lax.dot_general(
@@ -248,7 +271,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
     v = _zero_oob_rows(v_ref[0, 0], ki * bk, sk)
     o = o_ref[0, 0]
     do = do_ref[0, 0]
-    b = bias_ref[0]
+    b = bias_ref[0, 0]  # [bk]
     lse = lse_ref[0, 0][:, :1]
 
     s = jax.lax.dot_general(
@@ -286,14 +309,14 @@ def _flash_bwd_pallas(q, k, v, key_bias, out, do, lse, causal: bool,
     """Hand-written backward: returns ``(dq, dk, dv, db[B, Sk])``."""
     B, H, S, D = q.shape
     Sk = k.shape[2]
-    bq = min(block_q, S)
-    bk = min(block_k, Sk)
+    bq, bk = _block_sizes(block_q, block_k, S, Sk)
     scale = 1.0 / (D ** 0.5)
     nq = pl.cdiv(S, bq)
     nk = pl.cdiv(Sk, bk)
 
     kw = dict(scale=scale, causal=causal, bq=bq, bk=bk, sq=S, sk=Sk)
     interp = _interpret()
+    key_bias = key_bias[:, None, :]  # [B, 1, Sk] — see forward BlockSpec note
 
     dk, dv, db_h = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **kw),
@@ -302,7 +325,7 @@ def _flash_bwd_pallas(q, k, v, key_bias, out, do, lse, causal: bool,
             pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, bk), lambda b, h, ki, qi: (b, ki)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, ki, qi: (b, 0, ki)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -332,7 +355,7 @@ def _flash_bwd_pallas(q, k, v, key_bias, out, do, lse, causal: bool,
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, bk, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, bk), lambda b, h, qi, ki: (b, ki)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, qi, ki: (b, 0, ki)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, LANES), lambda b, h, qi, ki: (b, h, qi, 0)),

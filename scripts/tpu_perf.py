@@ -37,10 +37,22 @@ def _time_call(fn, *args, iters=3, warmup=1, chain=False):
     input depend on the previous result, which defeats any request-level
     caching in the tunnel (PERF.md measurement hygiene).
 
-    Fences with core.fence: on the tunnelled backend block_until_ready
-    returns before the device finishes, which would time dispatch enqueue
-    only (bench.py "measured" 332,370% MFU that way)."""
+    Fences: on the tunnelled backend block_until_ready returns before the
+    device finishes, which would time dispatch enqueue only (bench.py
+    "measured" 332,370% MFU that way). Warmups fence with the eager
+    core.fence; the TIMED region fences through one pre-compiled scalar
+    readback (one tunnel RTT — the eager fence's ~3 RTTs of per-op
+    dispatch would materially inflate millisecond-scale kernel rows)."""
+    import jax
+    import jax.numpy as jnp
+
     from bcfl_tpu.core.fence import fence
+
+    syncer = jax.jit(lambda l: l.ravel()[0].astype(jnp.float32))
+
+    def timed_fence(out):
+        jax.block_until_ready(out)
+        return float(syncer(jax.tree.leaves(out)[0]))
 
     if chain:
         # warmup 1 compiles for the original (uncommitted) input layout,
@@ -59,12 +71,13 @@ def _time_call(fn, *args, iters=3, warmup=1, chain=False):
             first = out  # fn on the ORIGINAL args — the numerics oracle
         if chain and args:
             x = out
+    timed_fence(out)  # compile the syncer outside the timed region
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(x, *args[1:]) if args else fn()
         if chain:
             x = out
-    fence(out)
+    timed_fence(out)
     dt = (time.perf_counter() - t0) / iters
     return dt, (first if first is not None else out)
 

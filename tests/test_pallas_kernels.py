@@ -130,3 +130,26 @@ def test_pallas_backward_uneven_blocks():
         (0, 1, 2, 3))(q, k, v, bias)
     for a, b in zip(gp, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_pallas_odd_block_sizes_clamped():
+    """Real-TPU Mosaic requires block last-two dims to divide (8, 128) or
+    equal the array dims; odd caller block sizes are clamped to the nearest
+    legal ones (caught on silicon — a (1, bk) bias block failed lowering at
+    every seq length while interpret mode passed)."""
+    from bcfl_tpu.ops.pallas_flash import _block_sizes
+
+    assert _block_sizes(200, 200, 512, 512) == (200, 128)  # bq 200 % 8 == 0
+    assert _block_sizes(67, 130, 512, 512) == (64, 128)
+    assert _block_sizes(256, 256, 96, 96) == (96, 96)  # == dims: legal as-is
+    assert _block_sizes(4, 64, 512, 512) == (8, 128)  # floors at one tile
+    # sub-tile request on a sub-tile-multiple dim: the whole dim is the
+    # nearest legal block (bk=128 > Sk=96 would pad 32 dead lanes)
+    assert _block_sizes(64, 64, 96, 96) == (64, 96)
+    assert _block_sizes(4, 64, 6, 6) == (6, 6)  # dim smaller than a tile
+
+    B, H, S, D = 2, 2, 96, 16
+    q, k, v = _qkv((B, H, S, D))
+    out = flash_pl(q, k, v, None, False, 67, 130)  # odd blocks, clamped
+    ref = dot_product_attention(q, k, v, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

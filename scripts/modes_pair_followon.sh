@@ -1,0 +1,47 @@
+#!/bin/bash
+# Follow-on CPU stage: once session_queue's worker pair finishes (or dies),
+# run the matched-budget small-bert modes pair so RESULTS.md gains a
+# serverless-vs-server ordering at small-bert scale (VERDICT r4 Weak #3).
+# Both legs run at the SAME reduced budget (8 rounds, eval 16 batches every
+# 2nd round) — the ordering note only compares within a matched pair. The
+# --key-suffix keeps the tiny-bert 20-round rows intact in summary.json.
+set -u
+cd /root/repo
+LOG=results/modes_pair_followon.log
+say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+exec 9< "$0"
+if ! flock -n 9; then
+  echo "another modes_pair_followon holds the lock" >&2
+  exit 1
+fi
+
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export JAX_PLATFORMS=cpu
+
+say "waiting for worker pair"
+while pgrep -f "worker_pair.py" > /dev/null; do
+  sleep 120
+done
+say "worker pair done/not running; starting smallbert modes pair"
+
+# the old 10-round serverless smallbert artifact shares this filename;
+# keep it (the new pair is 8 rounds — different budget, both are evidence)
+[ -f results/serverless_noniid_medical_smallbert.json ] \
+  && [ ! -f results/serverless_noniid_medical_smallbert_r10.bak.json ] \
+  && cp results/serverless_noniid_medical_smallbert.json \
+        results/serverless_noniid_medical_smallbert_r10.bak.json
+
+if [ ! -f results/modes_pair_smallbert_done ]; then
+  if nice -n 19 timeout -k 30 21600 python scripts/run_results.py \
+       --platform cpu --model small-bert --rounds 8 \
+       --eval-batches 16 --eval-every 2 --key-suffix _smallbert \
+       --configs server_iid_medical serverless_noniid_medical \
+       >> "$LOG" 2>&1; then
+    touch results/modes_pair_smallbert_done
+    say "modes pair done -> RESULTS.md"
+  else
+    say "modes pair failed/timed out (partial summary keys may exist)"
+  fi
+fi
+say "follow-on exiting"

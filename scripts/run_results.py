@@ -67,6 +67,12 @@ def main(argv=None):
     ap.add_argument("--out", default="results")
     ap.add_argument("--configs", nargs="*", default=None,
                     help="subset of config names to run")
+    ap.add_argument("--key-suffix", default="",
+                    help="append to every summary key / artifact filename "
+                    "(e.g. _smallbert) so a re-run at a different budget "
+                    "accumulates NEXT TO earlier rows instead of "
+                    "overwriting them; the mode-ordering note checks each "
+                    "suffix's pair independently")
     ap.add_argument("--fresh", action="store_true",
                     help="start a new summary.json instead of merging into "
                     "an existing one (merging keeps stale entries from runs "
@@ -152,6 +158,9 @@ def main(argv=None):
 
     dev = jax.devices()[0]
     platform = f"{dev.platform} ({dev.device_kind}, {os.cpu_count()} host cores)"
+
+    if args.key_suffix:
+        configs = {name + args.key_suffix: cfg for name, cfg in configs.items()}
 
     summary = {}
     for name, cfg in configs.items():
@@ -265,19 +274,36 @@ def _mode_ordering_note(summary, out_dir):
     final), so the honest offline check is whether the SIGNS reproduce at
     matched budgets. A merged summary can hold runs recorded under
     different flags; comparing those would conflate budget with mode."""
-    sv = summary.get("server_iid_medical")
-    sl = summary.get("serverless_noniid_medical")
-    if not (sv and sl):
+    # every --key-suffix re-run contributes its own pair; each is compared
+    # only within its own suffix (matching budgets is checked per pair)
+    pairs = []
+    for key in sorted(summary):
+        if not key.startswith("server_iid_medical"):
+            continue
+        suf = key[len("server_iid_medical"):]
+        sv = summary.get("server_iid_medical" + suf)
+        sl = summary.get("serverless_noniid_medical" + suf)
+        if not (sv and sl):
+            continue
+        if any(sv.get(k) != sl.get(k)
+               for k in ("model", "rounds", "seq_len", "hf_weights",
+                         "clients", "max_eval_batches", "eval_every")):
+            continue
+        if sv.get("final_acc") is None or sl.get("final_acc") is None:
+            continue
+        pairs.append((sv, sl))
+    if not pairs:
         return ""
-    if any(sv.get(k) != sl.get(k)
-           for k in ("model", "rounds", "seq_len", "hf_weights", "clients",
-                     "max_eval_batches", "eval_every")):
-        return ""
-    if sv.get("final_acc") is None or sl.get("final_acc") is None:
-        return ""
+    lines = ["## Mode ordering vs the reference's headline claims", ""]
+    for sv, sl in pairs:
+        lines += _pair_ordering_lines(sv, sl)
+    lines += _worker_pair_lines(out_dir)
+    lines.append("")
+    return "\n".join(lines)
+
+
+def _pair_ordering_lines(sv, sl):
     lines = [
-        "## Mode ordering vs the reference's headline claims",
-        "",
         f"Matched budget ({sv['model']}, {sv['clients']} clients, "
         f"{sv['rounds']} rounds, seq {sv.get('seq_len')}):",
         "",
@@ -298,6 +324,12 @@ def _mode_ordering_note(summary, out_dir):
             f"server {sv['wall_minutes']:.1f} min ({lat_gap:+.1f}) — the "
             f"serverless<server sign {sign} here (reference MT nb cell 15: "
             "105/122/187 vs 280/628/810 min).")
+    lines.append("")
+    return lines
+
+
+def _worker_pair_lines(out_dir):
+    lines = []
     wp_path = os.path.join(out_dir, "worker_pair_smallbert.json")
     try:
         with open(wp_path) as f:
@@ -325,8 +357,7 @@ def _mode_ordering_note(summary, out_dir):
                     "spread; results/worker_pair_smallbert.json).")
     except (OSError, json.JSONDecodeError):
         pass
-    lines.append("")
-    return "\n".join(lines)
+    return lines
 
 
 def _write_results_md(args, summary):
@@ -392,7 +423,11 @@ def _write_results_md(args, summary):
         return format(v, spec) if v is not None else "—"
 
     for name, s in summary.items():
-        r = ref.get(name, {})
+        # suffixed keys (--key-suffix) still get their base config's
+        # reference column: longest-prefix match over the REFERENCE names
+        r = ref.get(name) or next(
+            (ref[base] for base in sorted(ref, key=len, reverse=True)
+             if name.startswith(base)), {})
         lines.append(
             f"| {name} | "
             f"{s.get('model', '?')} ({s.get('rounds', '?')}) | "
@@ -429,7 +464,14 @@ def _write_results_md(args, summary):
     ordering = _mode_ordering_note(summary, args.out)
     if ordering:
         lines += [ordering, ""]
-    bc = summary.get("bcfl_async_pagerank_medical")
+    def _any_key(prefix):
+        # exact first, else any suffixed variant (--key-suffix runs)
+        if prefix in summary:
+            return summary[prefix]
+        return next((summary[k] for k in sorted(summary)
+                     if k.startswith(prefix)), None)
+
+    bc = _any_key("bcfl_async_pagerank_medical")
     if bc:
         lines += [
             "## BC-FL extension (implemented, not just modeled)",
@@ -447,8 +489,8 @@ def _write_results_md(args, summary):
             "class).",
             "",
         ]
-    sdv = summary.get("sdv_serverless_iid")
-    sdv_aug = summary.get("sdv_serverless_iid_ctgan")
+    sdv = _any_key("sdv_serverless_iid")
+    sdv_aug = _any_key("sdv_serverless_iid_ctgan")
     if sdv and sdv_aug:
         lines += [
             "## Synthetic-data augmentation on the self-driving corpus",

@@ -54,6 +54,9 @@ def _time_call(fn, *args, iters=3, warmup=1, chain=False):
         jax.block_until_ready(out)
         return float(syncer(jax.tree.leaves(out)[0]))
 
+    # >= 1 warmup always: the timed fence's syncer is compiled during
+    # warmup, and warmup=0 would leave `out` unbound before the timed loop
+    warmup = max(warmup, 1)
     if chain:
         # warmup 1 compiles for the original (uncommitted) input layout,
         # warmup 2 for the chained layout (the output's sharding/layout can

@@ -5,6 +5,9 @@
 # Both legs run at the SAME reduced budget (8 rounds, eval 16 batches every
 # 2nd round) — the ordering note only compares within a matched pair. The
 # --key-suffix keeps the tiny-bert 20-round rows intact in summary.json.
+# (The pre-existing 10-round serverless artifact lives at
+# results/serverless_noniid_medical_smallbert_r10.json / summary key
+# ..._smallbert_r10 — it does not collide with this pair.)
 set -u
 cd /root/repo
 LOG=results/modes_pair_followon.log
@@ -19,29 +22,43 @@ fi
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_PLATFORMS=cpu
 
+# gate on the SUMMARY key, not the per-run JSON: run_results writes the
+# JSON before the summary merge/render, so a kill in that window would
+# otherwise mark the leg done while its summary row (the thing the
+# ordering note reads) is missing
+has_key() {
+  python - "$1" <<'PY' 2>/dev/null
+import json, sys
+keys = json.load(open("results/summary.json"))
+sys.exit(0 if sys.argv[1] in keys else 1)
+PY
+}
+
 say "waiting for worker pair"
 while pgrep -f "worker_pair.py" > /dev/null; do
   sleep 120
 done
 say "worker pair done/not running; starting smallbert modes pair"
 
-# the old 10-round serverless smallbert artifact shares this filename;
-# keep it (the new pair is 8 rounds — different budget, both are evidence)
-[ -f results/serverless_noniid_medical_smallbert.json ] \
-  && [ ! -f results/serverless_noniid_medical_smallbert_r10.bak.json ] \
-  && cp results/serverless_noniid_medical_smallbert.json \
-        results/serverless_noniid_medical_smallbert_r10.bak.json
-
-if [ ! -f results/modes_pair_smallbert_done ]; then
-  if nice -n 19 timeout -k 30 21600 python scripts/run_results.py \
-       --platform cpu --model small-bert --rounds 8 \
-       --eval-batches 16 --eval-every 2 --key-suffix _smallbert \
-       --configs server_iid_medical serverless_noniid_medical \
-       >> "$LOG" 2>&1; then
-    touch results/modes_pair_smallbert_done
-    say "modes pair done -> RESULTS.md"
-  else
-    say "modes pair failed/timed out (partial summary keys may exist)"
+# one invocation per leg: each merges into summary.json on completion, so
+# a session cut mid-pair still lands the finished leg (the ordering note
+# needs both, but a lone leg is still a recorded run)
+for leg in server_iid_medical serverless_noniid_medical; do
+  if ! has_key "${leg}_smallbert"; then
+    say "leg $leg start"
+    if nice -n 19 timeout -k 30 14400 python scripts/run_results.py \
+         --platform cpu --model small-bert --rounds 8 \
+         --eval-batches 16 --eval-every 2 --key-suffix _smallbert \
+         --configs "$leg" >> "$LOG" 2>&1; then
+      say "leg $leg done"
+    else
+      say "leg $leg failed/timed out"
+    fi
   fi
+done
+if has_key server_iid_medical_smallbert \
+   && has_key serverless_noniid_medical_smallbert; then
+  touch results/modes_pair_smallbert_done
+  say "modes pair done -> RESULTS.md"
 fi
 say "follow-on exiting"

@@ -464,12 +464,15 @@ def _write_results_md(args, summary):
     ordering = _mode_ordering_note(summary, args.out)
     if ordering:
         lines += [ordering, ""]
-    def _any_key(prefix):
-        # exact first, else any suffixed variant (--key-suffix runs)
+    def _any_key(prefix, exclude=None):
+        # exact first, else any suffixed variant (--key-suffix runs);
+        # `exclude` keeps a sibling config that extends the prefix (e.g.
+        # sdv_serverless_iid_ctgan vs sdv_serverless_iid) from matching
         if prefix in summary:
             return summary[prefix]
         return next((summary[k] for k in sorted(summary)
-                     if k.startswith(prefix)), None)
+                     if k.startswith(prefix)
+                     and not (exclude and k.startswith(exclude))), None)
 
     bc = _any_key("bcfl_async_pagerank_medical")
     if bc:
@@ -489,7 +492,7 @@ def _write_results_md(args, summary):
             "class).",
             "",
         ]
-    sdv = _any_key("sdv_serverless_iid")
+    sdv = _any_key("sdv_serverless_iid", exclude="sdv_serverless_iid_ctgan")
     sdv_aug = _any_key("sdv_serverless_iid_ctgan")
     if sdv and sdv_aug:
         lines += [

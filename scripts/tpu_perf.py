@@ -21,10 +21,45 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
+
+
+class _Watchdog:
+    """Per-stage deadline (same pattern as bench.py): a tunnelled backend
+    can wedge forever inside a compile or transfer, and the in-process
+    attention/ledger stages would otherwise hang without writing PERF.md —
+    this session one did exactly that and had to be killed by hand. On
+    expiry: log the stage, exit 3 (bench_sweep's rows are printed as they
+    land, so completed evidence survives in the log)."""
+
+    def __init__(self, timeout_s: float = 1200.0):
+        self._timeout = timeout_s
+        self._timer = None
+        self.name = "start"
+
+    def stage(self, name: str, timeout_s: float = None):
+        self.name = name
+        self.cancel()
+        self._armed = self._timeout if timeout_s is None else timeout_s
+        self._timer = threading.Timer(self._armed, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self):
+        print(f"WATCHDOG: stage {self.name!r} made no progress within "
+              f"{self._armed:.0f}s (wedged tunnel?); exiting", flush=True)
+        os._exit(3)
+
+    def cancel(self):
+        if self._timer is not None:
+            self._timer.cancel()
+
+
+WATCHDOG = _Watchdog()
 
 
 def _time_call(fn, *args, iters=3, warmup=1, chain=False):
@@ -131,8 +166,20 @@ def attention_sweep(quick=False):
 
     B, H, D = (1, 2, 32) if quick else (2, 12, 64)
     seqs = [256, 512] if quick else [512, 1024, 2048, 4096]
+    # platform-keyed partial dump (same clobber class as ledger_auth: a CPU
+    # plumbing check must not overwrite a TPU run's partial evidence), and
+    # cleared at sweep start so a wedge before the first row cannot leave a
+    # stale prior run's file posing as this run's
+    plat = "tpu" if jax.default_backend() == "tpu" else jax.default_backend()
+    partial = os.path.join(REPO_ROOT, "results",
+                           f"attention_rows_partial_{plat}.json")
+    if os.path.exists(partial):
+        os.remove(partial)
     rows = []
     for S in seqs:
+        # ~5 kernel compiles + 4 timed legs per seq; generous but finite —
+        # a wedge must cost one stage window, not the whole session
+        WATCHDOG.stage(f"attention:seq={S}", 1800.0)
         q = jax.random.normal(jax.random.key(0), (B, H, S, D), jnp.bfloat16)
 
         def pl_fwd(q):
@@ -194,6 +241,11 @@ def attention_sweep(quick=False):
                          and not k.endswith("_err") else v)
                      for k, v in row.items()})
         print(f"attention seq={S}: {rows[-1]}", flush=True)
+        # incremental dump: a watchdog exit on a later seq keeps the
+        # completed rows as structured data, not just log lines
+        with open(partial, "w") as f:
+            json.dump(rows, f, indent=1)
+    WATCHDOG.cancel()
     return f"B={B}, H={H}, D={D}", rows
 
 
@@ -352,10 +404,14 @@ def main(argv=None):
         # site hooks on some hosts, so bench.py honors this explicit knob
         os.environ["BCFL_BENCH_PLATFORM"] = args.platform
 
+    WATCHDOG.stage("backend-init", 300.0)
     import jax
 
     device = jax.devices()[0].device_kind
     print(f"device: {device}", flush=True)
+    # bench subprocesses carry their own per-stage watchdogs and a 5400s
+    # outer timeout; the in-process watchdog must not cut them short
+    WATCHDOG.cancel()
     bench_rows = [] if args.skip_bench else bench_sweep(args.trace_dir,
                                                         args.quick)
     # an attention failure must not discard the completed bench evidence
@@ -365,14 +421,20 @@ def main(argv=None):
         print(f"attention sweep failed: {type(e).__name__}: {e}", flush=True)
         attn_shape, attn_rows = f"FAILED: {type(e).__name__}: {e}", []
     try:
+        WATCHDOG.stage("ledger-auth", 1800.0)
         auth = dict(ledger_auth_check(), device=device)
-        path = os.path.join(REPO_ROOT, "results", "tpu_ledger_auth.json")
+        # platform-keyed filename: a CPU plumbing check must never clobber
+        # the recorded silicon artifact (it did, twice, this session)
+        fname = ("tpu_ledger_auth.json" if "TPU" in device
+                 else "cpu_ledger_auth.json")
+        path = os.path.join(REPO_ROOT, "results", fname)
         with open(path, "w") as f:
             json.dump(auth, f, indent=2)
         print(f"ledger auth check: {auth} -> {path}", flush=True)
     except Exception as e:  # noqa: BLE001 — evidence must survive
         print(f"ledger auth check failed: {type(e).__name__}: {e}",
               flush=True)
+    WATCHDOG.cancel()
     write_perf_md(device, bench_rows, attn_shape, attn_rows, args.trace_dir)
     print("wrote PERF.md", flush=True)
 

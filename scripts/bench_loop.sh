@@ -109,8 +109,10 @@ while true; do
     # so the serverless-vs-server ordering is measurable above noise
     if [ ! -f results/modes_smallbert_done ]; then
       say "running small-bert mode comparison"
+      # --key-suffix: accumulate NEXT TO the tiny-bert 20-round rows
+      # (without it this stage overwrites those summary keys)
       if timeout -k 10 14400 python scripts/run_results.py \
-           --model small-bert --rounds 20 \
+           --model small-bert --rounds 20 --key-suffix _smallbert_tpu \
            >> results/modes_smallbert.log 2>&1; then
         touch results/modes_smallbert_done
         say "mode comparison done -> RESULTS.md"

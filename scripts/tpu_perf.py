@@ -170,7 +170,10 @@ def attention_sweep(quick=False):
     # plumbing check must not overwrite a TPU run's partial evidence), and
     # cleared at sweep start so a wedge before the first row cannot leave a
     # stale prior run's file posing as this run's
-    plat = "tpu" if jax.default_backend() == "tpu" else jax.default_backend()
+    # keyed by device kind, matching the ledger-auth artifact (the tunnelled
+    # TPU's backend NAME is "axon", so default_backend() would mislabel it)
+    plat = ("tpu" if "TPU" in jax.devices()[0].device_kind
+            else jax.default_backend())
     partial = os.path.join(REPO_ROOT, "results",
                            f"attention_rows_partial_{plat}.json")
     if os.path.exists(partial):
@@ -180,7 +183,6 @@ def attention_sweep(quick=False):
         # ~5 kernel compiles + 4 timed legs per seq; generous but finite —
         # a wedge must cost one stage window, not the whole session
         WATCHDOG.stage(f"attention:seq={S}", 1800.0)
-        q = jax.random.normal(jax.random.key(0), (B, H, S, D), jnp.bfloat16)
 
         def pl_fwd(q):
             return flash_pl(q, q, q, None, True, 256, 256)
@@ -200,6 +202,10 @@ def attention_sweep(quick=False):
         # interpret mode) must not discard the completed rows: record an
         # error row and move to the next length, like bench_sweep does
         try:
+            # q allocation inside the try: a device allocation failure at
+            # one length must also fall into the error-row path
+            q = jax.random.normal(jax.random.key(0), (B, H, S, D),
+                                  jnp.bfloat16)
             jpf, jxf = jax.jit(pl_fwd), jax.jit(xla_fwd)
             jpb, jxb = jax.jit(pl_bwd), jax.jit(xla_bwd)
             # chain=True: attention in/out shapes match, so each timed call
@@ -246,6 +252,11 @@ def attention_sweep(quick=False):
         with open(partial, "w") as f:
             json.dump(rows, f, indent=1)
     WATCHDOG.cancel()
+    # completed sweep: promote the partial to its final name so a leftover
+    # *_partial_* file always means a genuinely interrupted run
+    if os.path.exists(partial):
+        os.replace(partial, os.path.join(
+            REPO_ROOT, "results", f"attention_rows_{plat}.json"))
     return f"B={B}, H={H}, D={D}", rows
 
 

@@ -1,0 +1,75 @@
+"""run_results rendering helpers: the mode-ordering note's per-suffix pair
+scan, sibling-exclusion in prefix lookups, and the reference-column match
+for --key-suffix rows. Pure host-side (no backend), so these run in
+milliseconds — they pin the machinery that writes RESULTS.md's derived
+ordering block (reference README.md:10's headline claims)."""
+
+import importlib.util
+import os
+
+
+_SPEC = importlib.util.spec_from_file_location(
+    "run_results", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "run_results.py"))
+rr = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(rr)
+
+
+def _entry(model="tiny-bert", rounds=20, final=0.3, wall=20.0, **kw):
+    e = {"model": model, "rounds": rounds, "seq_len": 64,
+         "hf_weights": False, "clients": 10, "max_eval_batches": 32,
+         "eval_every": 1, "final_acc": final, "wall_minutes": wall}
+    e.update(kw)
+    return e
+
+
+def test_ordering_note_matches_within_suffix(tmp_path):
+    summary = {
+        "server_iid_medical": _entry(final=0.32, wall=26.0),
+        "serverless_noniid_medical": _entry(final=0.31, wall=21.0),
+        "server_iid_medical_smallbert": _entry("small-bert", 8, 0.40, 60.0),
+        "serverless_noniid_medical_smallbert": _entry(
+            "small-bert", 8, 0.44, 55.0),
+    }
+    note = rr._mode_ordering_note(summary, str(tmp_path))
+    assert note.count("Matched budget") == 2
+    assert "tiny-bert, 10 clients, 20 rounds" in note
+    assert "small-bert, 10 clients, 8 rounds" in note
+
+
+def test_ordering_note_skips_mismatched_budgets(tmp_path):
+    summary = {
+        "server_iid_medical_x": _entry(rounds=20),
+        "serverless_noniid_medical_x": _entry(rounds=8),  # budget differs
+    }
+    assert rr._mode_ordering_note(summary, str(tmp_path)) == ""
+
+
+def test_ordering_note_requires_both_modes(tmp_path):
+    summary = {"server_iid_medical_smallbert": _entry("small-bert")}
+    assert rr._mode_ordering_note(summary, str(tmp_path)) == ""
+
+
+def test_pair_lines_state_signs():
+    sv = _entry(final=0.32, wall=26.0)
+    sl = _entry(final=0.31, wall=21.0)
+    text = "\n".join(rr._pair_ordering_lines(sv, sl))
+    # acc gap negative, latency gap negative (serverless faster)
+    assert "does NOT reproduce" in text and "REPRODUCES" in text
+
+
+def test_worker_pair_lines_read_artifact(tmp_path):
+    import json
+
+    wp = {"model": "small-bert", "rounds": 4, "seq_len": 96,
+          "iid_samples": 250,
+          "runs": {"5": {"final_acc": 0.199}, "20": {"final_acc": 0.215}}}
+    with open(tmp_path / "worker_pair_smallbert.json", "w") as f:
+        json.dump(wp, f)
+    lines = rr._worker_pair_lines(str(tmp_path))
+    assert any("5 workers 0.199 -> 20 workers 0.215" in l for l in lines)
+    assert any("rises" in l for l in lines)
+
+
+def test_worker_pair_lines_missing_artifact(tmp_path):
+    assert rr._worker_pair_lines(str(tmp_path)) == []

@@ -8,11 +8,13 @@
 #
 #   A. headline GSPMD bench, recompile-free   -> results/bench_r05_fixed.json
 #   B. serverless-mode bench                  -> results/bench_r05_serverless.json
-#   0. dispatch-gap bisect (diagnostic, after the benches — a healthy
-#      window may be short; falls through)    -> results/dispatch_bisect_tpu.json
 #   C. tpu_perf.py kernel + dispatch sweep    -> PERF.md (+ tpu_perf_done)
-#   D. scaling ladder 4/16/64 clients         -> SCALING.md (+ scaling_tpu_done)
+#   C2. rbg hardware-PRNG bonus bench         -> results/bench_r05_rbg.json
+#   0. dispatch-gap bisect (diagnostic; re-probes first and only cancels
+#      itself after failing in a freshly-proven-healthy window)
+#                                             -> results/dispatch_bisect_tpu.json
 #   E. small-bert 3-mode comparison           -> RESULTS.md (+ modes_smallbert_done)
+#   D. scaling ladder 4/16/64 clients         -> SCALING.md (+ scaling_tpu_done)
 #
 # Each stage is skipped once its artifact exists, so the loop is resumable.
 # All child invocations use `timeout -k` (a wedged init ignores SIGTERM).
@@ -70,8 +72,35 @@ while true; do
     if [ ! -f results/bench_r05_serverless.json ]; then
       run_bench serverless results/bench_r05_serverless.json || { sleep "$PERIOD"; continue; }
     fi
+    # STAGE ORDER (r05 final session): the kernel timing table
+    # (tpu_perf) is the round's biggest open evidence item, and a
+    # healthy window may be minutes long — it runs FIRST; the rbg
+    # bonus bench is one short run; the 2h dispatch bisect is a
+    # diagnostic whose root cause is already pinned (CPU bisect +
+    # tests), so it goes last of the three.
+    if [ ! -f results/tpu_perf_done ]; then
+      say "running tpu_perf sweep"
+      if timeout -k 10 14400 python scripts/tpu_perf.py \
+           --trace-dir results/perf_trace \
+           >> results/tpu_perf_r05.log 2>&1; then
+        touch results/tpu_perf_done
+        say "tpu_perf done -> PERF.md"
+      else
+        say "tpu_perf failed/timed out"
+      fi
+    fi
+    # bonus row: the TPU hardware PRNG (dropout RNG is +38% of step time
+    # under threefry, PERF.md); recorded separately, never the headline
+    if [ ! -f results/bench_r05_rbg.json ]; then
+      run_bench server results/bench_r05_rbg.json BCFL_BENCH_PRNG=rbg \
+        || say "rbg bonus bench failed (non-gating)"
+    fi
+    # re-probe before the bisect: hours may have passed inside tpu_perf /
+    # rbg, and a bisect against a meanwhile-wedged tunnel would time out
+    # and permanently cancel itself; only a run that fails in a
+    # freshly-proven-healthy window counts as a real failure
     if [ ! -f results/dispatch_bisect_tpu.json ] \
-       && [ ! -f results/dispatch_bisect_failed ]; then
+       && [ ! -f results/dispatch_bisect_failed ] && probe; then
       say "running dispatch bisect"
       if BISECT_OUT=results/dispatch_bisect_tpu.json \
            timeout -k 10 7200 python scripts/dispatch_bisect.py \
@@ -86,23 +115,6 @@ while true; do
           && cp results/dispatch_bisect_tpu.json results/dispatch_bisect_tpu_partial.json
         rm -f results/dispatch_bisect_tpu.json
         touch results/dispatch_bisect_failed
-      fi
-    fi
-    # bonus row: the TPU hardware PRNG (dropout RNG is +38% of step time
-    # under threefry, PERF.md); recorded separately, never the headline
-    if [ ! -f results/bench_r05_rbg.json ]; then
-      run_bench server results/bench_r05_rbg.json BCFL_BENCH_PRNG=rbg \
-        || say "rbg bonus bench failed (non-gating)"
-    fi
-    if [ ! -f results/tpu_perf_done ]; then
-      say "running tpu_perf sweep"
-      if timeout -k 10 14400 python scripts/tpu_perf.py \
-           --trace-dir results/perf_trace \
-           >> results/tpu_perf_r05.log 2>&1; then
-        touch results/tpu_perf_done
-        say "tpu_perf done -> PERF.md"
-      else
-        say "tpu_perf failed/timed out"
       fi
     fi
     # VERDICT r3 #6: the three modes at small-bert scale, identical budgets,

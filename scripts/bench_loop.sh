@@ -26,10 +26,18 @@ PERIOD=${BENCH_LOOP_PERIOD:-900}
 say() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
 
 probe() {
+  # device enumeration alone is NOT health: on 2026-08-01 the tunnel
+  # listed the chip fine while every compile RPC wedged (a bench burned
+  # its full 1200s compile watchdog right after a green listing-probe).
+  # The probe therefore compiles + runs a tiny jit and fences through a
+  # host readback — only a tunnel that can compile AND execute is green.
   timeout -k 10 240 python -c "
-import jax
+import jax, jax.numpy as jnp
 d = jax.devices()
 assert d[0].platform == 'tpu', d
+y = jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(
+    jnp.ones((128, 128), jnp.bfloat16))
+assert float(y) == 128.0 ** 3, float(y)
 print(d[0].device_kind)
 " >> "$LOG" 2>&1
 }
@@ -54,7 +62,8 @@ while true; do
        || [ -f results/dispatch_bisect_failed ]; } \
      && [ -f results/bench_r05_fixed.json ] \
      && [ -f results/bench_r05_serverless.json ] \
-     && [ -f results/tpu_perf_done ] \
+     && { [ -f results/tpu_perf_done ] \
+          || [ -f results/tpu_perf_failed ]; } \
      && [ -f results/scaling_tpu_done ] \
      && [ -f results/modes_smallbert_done ]; then
     say "all stages done; exiting"
@@ -78,15 +87,38 @@ while true; do
     # bonus bench is one short run; the 2h dispatch bisect is a
     # diagnostic whose root cause is already pinned (CPU bisect +
     # tests), so it goes last of the three.
-    if [ ! -f results/tpu_perf_done ]; then
+    if [ ! -f results/tpu_perf_done ] && [ ! -f results/tpu_perf_failed ]; then
       say "running tpu_perf sweep"
-      if timeout -k 10 14400 python scripts/tpu_perf.py \
-           --trace-dir results/perf_trace \
-           >> results/tpu_perf_r05.log 2>&1; then
+      # --skip-bench: the 5-shape dispatch table is already recorded
+      # (results/bench_sweep_rows_tpu.json reuses it for PERF.md) — the
+      # open evidence item is ONLY the attention timing table;
+      # --skip-ledger-auth: results/tpu_ledger_auth.json is already
+      # recorded on silicon and each re-run risks an 1800s wedge burn
+      timeout -k 10 14400 python scripts/tpu_perf.py \
+           --skip-bench --skip-ledger-auth \
+           >> results/tpu_perf_r05.log 2>&1
+      rc=$?
+      # rc 0 = all rows clean; rc 4 = sweep COMPLETED but some seq rows
+      # errored (genuine kernel failures, recorded in PERF.md — a retry
+      # reproduces them, so the stage is done either way); anything else
+      # (watchdog 3, retry-worthy 5, timeout 124) retries next window
+      if [ "$rc" -eq 0 ] || [ "$rc" -eq 4 ]; then
         touch results/tpu_perf_done
-        say "tpu_perf done -> PERF.md"
+        rm -f results/tpu_perf_attempts
+        say "tpu_perf done (rc=$rc) -> PERF.md"
       else
-        say "tpu_perf failed/timed out"
+        # cap retries: a deterministic all-error failure (rc=5) or a
+        # repeatedly wedging sweep must not burn every healthy window
+        # forever (bisect precedent) — after 3 failures, mark failed and
+        # let the later stages have the windows
+        n=$(( $(cat results/tpu_perf_attempts 2>/dev/null || echo 0) + 1 ))
+        echo "$n" > results/tpu_perf_attempts
+        say "tpu_perf failed/timed out (rc=$rc, attempt $n/3)"
+        if [ "$n" -ge 3 ]; then
+          touch results/tpu_perf_failed
+          rm -f results/tpu_perf_attempts
+          say "tpu_perf marked failed after $n attempts; later stages proceed"
+        fi
       fi
     fi
     # bonus row: the TPU hardware PRNG (dropout RNG is +38% of step time
@@ -100,7 +132,11 @@ while true; do
     # and permanently cancel itself; only a run that fails in a
     # freshly-proven-healthy window counts as a real failure
     if [ ! -f results/dispatch_bisect_tpu.json ] \
-       && [ ! -f results/dispatch_bisect_failed ] && probe; then
+       && [ ! -f results/dispatch_bisect_failed ]; then
+      if ! probe; then
+        say "bisect skipped: re-probe failed (tunnel re-wedged mid-window)"
+        sleep "$PERIOD"; continue
+      fi
       say "running dispatch bisect"
       if BISECT_OUT=results/dispatch_bisect_tpu.json \
            timeout -k 10 7200 python scripts/dispatch_bisect.py \

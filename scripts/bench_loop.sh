@@ -106,19 +106,23 @@ while true; do
         touch results/tpu_perf_done
         rm -f results/tpu_perf_attempts
         say "tpu_perf done (rc=$rc) -> PERF.md"
-      else
-        # cap retries: a deterministic all-error failure (rc=5) or a
-        # repeatedly wedging sweep must not burn every healthy window
-        # forever (bisect precedent) — after 3 failures, mark failed and
-        # let the later stages have the windows
+      elif [ "$rc" -eq 5 ]; then
+        # cap retries for DETERMINISTIC failures only (rc=5: the sweep
+        # completed and every row errored — a retry reproduces it); after
+        # 3, mark failed so later stages get the windows. Wedges and
+        # timeouts (rc 3/124/...) are transient tunnel states: they always
+        # retry in the next healthy window (bisect precedent: only a
+        # failure in a proven-healthy window counts)
         n=$(( $(cat results/tpu_perf_attempts 2>/dev/null || echo 0) + 1 ))
         echo "$n" > results/tpu_perf_attempts
-        say "tpu_perf failed/timed out (rc=$rc, attempt $n/3)"
+        say "tpu_perf deterministic failure (rc=5, attempt $n/3)"
         if [ "$n" -ge 3 ]; then
           touch results/tpu_perf_failed
           rm -f results/tpu_perf_attempts
           say "tpu_perf marked failed after $n attempts; later stages proceed"
         fi
+      else
+        say "tpu_perf wedged/timed out (rc=$rc); retrying next healthy window"
       fi
     fi
     # bonus row: the TPU hardware PRNG (dropout RNG is +38% of step time

@@ -2,9 +2,15 @@
 # Follow-on CPU stage: once session_queue's worker pair finishes (or dies),
 # run the matched-budget small-bert modes pair so RESULTS.md gains a
 # serverless-vs-server ordering at small-bert scale (VERDICT r4 Weak #3).
-# Both legs run at the SAME reduced budget (8 rounds, eval 16 batches every
-# 2nd round) — the ordering note only compares within a matched pair. The
-# --key-suffix keeps the tiny-bert 20-round rows intact in summary.json.
+# Both legs run at the SAME reduced budget (8 rounds, seq 64, eval 16
+# batches every 2nd round, server IID draw reduced to 400 to MATCH the
+# serverless leg's contiguous 400-span — disclosed in the ordering note) —
+# the ordering note only compares within a matched pair. The earlier
+# 16-round full-budget attempt ran 40 min/ROUND on this 1-core host
+# (results/modes_smallbert_cpu.log) and was cut after round 0; this budget
+# fits ~2.5h for the pair (scaled from the recorded 10-round serverless
+# small-bert leg, 108.8 min at seq 64 eval-every-1). The --key-suffix
+# keeps the tiny-bert 20-round rows intact in summary.json.
 # (The pre-existing 10-round serverless artifact lives at
 # results/serverless_noniid_medical_smallbert_r10.json / summary key
 # ..._smallbert_r10 — it does not collide with this pair.)
@@ -47,7 +53,8 @@ for leg in server_iid_medical serverless_noniid_medical; do
   if ! has_key "${leg}_smallbert"; then
     say "leg $leg start"
     if nice -n 19 timeout -k 30 14400 python scripts/run_results.py \
-         --platform cpu --model small-bert --rounds 8 \
+         --platform cpu --model small-bert --rounds 8 --seq-len 64 \
+         --iid-samples 400 \
          --eval-batches 16 --eval-every 2 --key-suffix _smallbert \
          --configs "$leg" >> "$LOG" 2>&1; then
       say "leg $leg done"

@@ -55,6 +55,14 @@ def main(argv=None):
                     "the tail). With the offline word-level hash tokenizer "
                     "the MT descriptions fit in 96 (p99 = 54 words), so "
                     "64-96 is a sound CPU-host speedup there only.")
+    ap.add_argument("--iid-samples", type=int, default=0,
+                    help="per-client IID draw per round for IID-partition "
+                    "configs (0 = each preset's default, e.g. 500 for "
+                    "server_iid_medical). Setting 400 matches the server "
+                    "leg's per-round training data to the serverless leg's "
+                    "contiguous 400-sample span on slow hosts; the value is "
+                    "recorded in the summary row and disclosed in the "
+                    "mode-ordering note. Non-IID configs are unaffected.")
     ap.add_argument("--eval-batches", type=int, default=0,
                     help="cap central eval batches per round (0 = full "
                     "3,000-row test split, the reference behaviour)")
@@ -153,6 +161,19 @@ def main(argv=None):
                     kind="iid", iid_samples=100, resample_each_round=True))
     if args.configs:
         configs = {k: v for k, v in configs.items() if k in args.configs}
+    if args.iid_samples:
+        # pin the TEST draw to the preset's effective value: iid_test_samples
+        # defaults to iid_samples (partition.py:84), so overriding only the
+        # train draw would silently shrink each client's local eval set too
+        configs = {
+            k: (cfg.replace(partition=dataclasses.replace(
+                    cfg.partition, iid_samples=args.iid_samples,
+                    iid_test_samples=(
+                        cfg.partition.iid_test_samples
+                        if cfg.partition.iid_test_samples is not None
+                        else cfg.partition.iid_samples)))
+                if cfg.partition.kind == "iid" else cfg)
+            for k, cfg in configs.items()}
 
     import jax
 
@@ -181,6 +202,8 @@ def main(argv=None):
             "seq_len": cfg.seq_len,
             "max_eval_batches": cfg.max_eval_batches,
             "eval_every": cfg.eval_every,
+            "iid_samples": (cfg.partition.iid_samples
+                            if cfg.partition.kind == "iid" else None),
             "dataset": cfg.dataset,
             "platform": platform,
             "final_acc": accs[-1] if accs else None,
@@ -303,9 +326,14 @@ def _mode_ordering_note(summary, out_dir):
 
 
 def _pair_ordering_lines(sv, sl):
+    # the IID draw applies to the server leg only (the serverless leg's
+    # contiguous Non-IID span is mode-intrinsic); disclose it when the
+    # summary recorded one so a reduced-budget pair reads as such
+    iid = (f", {sv['iid_samples']} IID samples/client/round (server leg)"
+           if sv.get("iid_samples") else "")
     lines = [
         f"Matched budget ({sv['model']}, {sv['clients']} clients, "
-        f"{sv['rounds']} rounds, seq {sv.get('seq_len')}):",
+        f"{sv['rounds']} rounds, seq {sv.get('seq_len')}{iid}):",
         "",
     ]
     acc_gap = sl["final_acc"] - sv["final_acc"]

@@ -125,6 +125,36 @@ def _bench_rows_path(plat):
     return os.path.join(REPO_ROOT, "results", f"bench_sweep_rows_{plat}.json")
 
 
+def _merge_rows(rows, prior_path, key):
+    """Merge this run's rows with a previously recorded artifact, per row:
+    a fresh clean row wins, a fresh ERROR row falls back to the prior clean
+    row for the same key, and prior-only keys are kept. Recorded evidence
+    is only ever improved, never shadowed by a transient failure.
+    ``key`` is a field name or tuple of field names."""
+    keys = (key,) if isinstance(key, str) else tuple(key)
+
+    def kf(r):
+        return tuple(r.get(x) for x in keys)
+
+    try:
+        with open(prior_path) as f:
+            loaded = json.load(f)
+        prior = {kf(r): r
+                 for r in (loaded["rows"] if isinstance(loaded, dict)
+                           else loaded)}
+    except (FileNotFoundError, json.JSONDecodeError, TypeError):
+        return rows
+    merged = []
+    for r in rows:
+        p = prior.get(kf(r))
+        merged.append(p if ("error" in r and p is not None
+                            and "error" not in p) else r)
+    seen = {kf(r) for r in merged}
+    merged += [r for k_, r in prior.items() if k_ not in seen]
+    return sorted(merged,
+                  key=lambda r: tuple((v is None, v) for v in kf(r)))
+
+
 def bench_sweep(trace_dir=None, quick=False, plat=None):
     """Headline bench at several (rounds, steps) dispatch shapes."""
     # (32, 8) last = the headline bench's default dispatch shape
@@ -268,14 +298,17 @@ def attention_sweep(quick=False, plat=None):
         with open(partial, "w") as f:
             json.dump(rows, f, indent=1)
     WATCHDOG.cancel()
-    # completed sweep: promote the partial to its final name so a leftover
-    # *_partial_* file always means a genuinely interrupted run — but only
-    # when at least one row is clean: an all-error table (transient RPC
-    # failure at every seq) must not shadow a previously recorded good one
-    # (same invariant as the bench-rows dump above)
+    # completed sweep: merge per seq with any previously recorded artifact —
+    # a fresh clean row supersedes an old one, but an old clean row must not
+    # be shadowed by a fresh transient error, and seqs only the prior run
+    # covered are kept (the promotion invariant, per ROW, matching the
+    # bench-table merge in main)
+    final = os.path.join(REPO_ROOT, "results", f"attention_rows_{plat}.json")
+    rows = _merge_rows(rows, final, key="seq")
     if os.path.exists(partial) and any("error" not in r for r in rows):
-        os.replace(partial, os.path.join(
-            REPO_ROOT, "results", f"attention_rows_{plat}.json"))
+        with open(final, "w") as f:
+            json.dump(rows, f, indent=1)
+        os.remove(partial)
     return f"B={B}, H={H}, D={D}", rows
 
 
@@ -397,15 +430,18 @@ def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir,
             f"| {r['rounds']} | {r['steps']} | {r['value']} | "
             f"{r['vs_baseline']} | {r.get('mfu_pct', '—')} |")
     failed_note = None
+    prev_attn_rows = _prev_table_rows(prev_section, "| seq | pallas fwd ms |")
     if not attn_rows and isinstance(attn_shape, str) \
             and attn_shape.startswith("FAILED"):
         # the sweep died before any row: the preserved rows below are the
         # PREVIOUS run's good evidence — keep its shape header rather than
-        # stamping recorded rows with this run's failure banner
+        # stamping recorded rows with this run's failure banner (and only
+        # claim preservation when there actually are rows to preserve)
         m = re.search(r"## Flash attention kernels \((.*), causal, bf16\)",
                       prev_section)
-        failed_note = f"(This run's sweep {attn_shape}; " \
-                      "previously recorded rows kept.)"
+        failed_note = (f"(This run's sweep {attn_shape}; "
+                       + ("previously recorded rows kept.)" if prev_attn_rows
+                          else "no previously recorded rows.)"))
         attn_shape = m.group(1) if m else "shape unknown"
     lines += [
         "",
@@ -423,7 +459,7 @@ def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir,
         # all-error sweep (main blanks the rows before the rc-5 exit) or no
         # sweep at all: keep the previously recorded attention rows rather
         # than shadowing them (same invariant as the bench table above)
-        lines += (_prev_table_rows(prev_section, "| seq | pallas fwd ms |")
+        lines += (prev_attn_rows
                   or ["| (no rows recorded this run) | | | | | | | | |"])
 
     def _fmt_err(v):
@@ -519,7 +555,12 @@ def main(argv=None):
             with open(_bench_rows_path(plat)) as f:
                 bench_rows = json.load(f)["rows"]
     else:
-        bench_rows = bench_sweep(args.trace_dir, args.quick, plat=plat)
+        # per-shape merge with the recorded artifact: a shape that errors
+        # this run (timeout, wedge-adjacent failure) must not overwrite its
+        # previously recorded row in PERF.md's dispatch table
+        bench_rows = _merge_rows(
+            bench_sweep(args.trace_dir, args.quick, plat=plat),
+            _bench_rows_path(plat), key=("rounds", "steps"))
     # an attention failure must not discard the completed bench evidence
     try:
         attn_shape, attn_rows = attention_sweep(args.quick, plat=plat)

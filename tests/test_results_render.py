@@ -58,6 +58,16 @@ def test_pair_lines_state_signs():
     assert "does NOT reproduce" in text and "REPRODUCES" in text
 
 
+def test_pair_lines_disclose_reduced_iid_draw():
+    sv = _entry(final=0.32, wall=26.0, iid_samples=400)
+    sl = _entry(final=0.35, wall=21.0)
+    text = "\n".join(rr._pair_ordering_lines(sv, sl))
+    assert "400 IID samples/client/round (server leg)" in text
+    # absent from the summary (older rows): no disclosure clause
+    text = "\n".join(rr._pair_ordering_lines(_entry(), _entry()))
+    assert "IID samples" not in text
+
+
 def test_worker_pair_lines_read_artifact(tmp_path):
     import json
 

@@ -340,10 +340,26 @@ def _pair_ordering_lines(sv, sl):
     ref_line = ("reference: serverless-NonIID 0.736 vs server-IID 0.68 "
                 "final (MT nb cell 31), README.md:10 claims +13%")
     sign = "REPRODUCES" if acc_gap > 0 else "does NOT reproduce"
+    # point-wise lead count over the shared eval cadence: a final-round
+    # ordering can hide the curve-level picture (e.g. serverless ahead at
+    # every eval but the last) — derived only when the curves are actually
+    # comparable (same eval rounds)
+    leads = ""
+    cv, cl = sv.get("acc_curve") or [], sl.get("acc_curve") or []
+    rounds_match = (sv.get("acc_rounds") == sl.get("acc_rounds")
+                    if sv.get("acc_rounds") or sl.get("acc_rounds")
+                    # pre-acc_rounds summaries: the caller already matched
+                    # rounds + eval_every, so equal-length curves share a
+                    # cadence
+                    else len(cv) == len(cl) and cv and cl)
+    if rounds_match and len(cv) == len(cl) and cv:
+        n_lead = sum(a > b for a, b in zip(cl, cv))
+        leads = (f" Point-wise, serverless led at {n_lead} of "
+                 f"{len(cv)} shared eval points.")
     lines.append(
         f"- **Accuracy**: serverless {sl['final_acc']:.3f} vs server "
         f"{sv['final_acc']:.3f} ({acc_gap:+.3f}) — the serverless>server "
-        f"sign {sign} here ({ref_line}).")
+        f"sign {sign} here ({ref_line}).{leads}")
     if sv.get("wall_minutes") and sl.get("wall_minutes"):
         lat_gap = sl["wall_minutes"] - sv["wall_minutes"]
         sign = "REPRODUCES" if lat_gap < 0 else "does NOT reproduce"

@@ -58,6 +58,25 @@ def test_pair_lines_state_signs():
     assert "does NOT reproduce" in text and "REPRODUCES" in text
 
 
+def test_pair_lines_count_pointwise_leads():
+    sv = _entry(final=0.408, acc_curve=[0.18, 0.31, 0.35, 0.408],
+                acc_rounds=[2, 4, 6, 8])
+    sl = _entry(final=0.402, acc_curve=[0.21, 0.32, 0.35, 0.402],
+                acc_rounds=[2, 4, 6, 8])
+    text = "\n".join(rr._pair_ordering_lines(sv, sl))
+    assert "serverless led at 2 of 4 shared eval points" in text
+    # mismatched eval cadences: no point-wise claim
+    sl2 = dict(sl, acc_rounds=[1, 2, 3, 4])
+    text = "\n".join(rr._pair_ordering_lines(sv, sl2))
+    assert "Point-wise" not in text
+    # pre-acc_rounds summaries (older rows): equal-length curves still get
+    # the line — the caller already matched rounds and eval cadence
+    sv3 = {k: v for k, v in sv.items() if k != "acc_rounds"}
+    sl3 = {k: v for k, v in sl.items() if k != "acc_rounds"}
+    text = "\n".join(rr._pair_ordering_lines(sv3, sl3))
+    assert "serverless led at 2 of 4 shared eval points" in text
+
+
 def test_pair_lines_disclose_reduced_iid_draw():
     sv = _entry(final=0.32, wall=26.0, iid_samples=400)
     sl = _entry(final=0.35, wall=21.0)

@@ -137,6 +137,16 @@ def main(argv=None):
             "server_iid_medical", hf=args.hf).replace(**common),
         "serverless_noniid_medical": get_preset(
             "serverless_noniid_medical", hf=args.hf).replace(**common),
+        # the reference's serverless as it ACTUALLY executes (SURVEY §3.2):
+        # clients train SEQUENTIALLY on one shared model object within each
+        # round, then snapshots are averaged — i.e. ~num_clients x more
+        # effective sequential optimization per round than independent
+        # clients. If the reference's serverless>server accuracy gap rides
+        # this quirk, this config reproduces it where the default
+        # independent-clients serverless (above) measures a near-tie.
+        "faithful_noniid_medical": get_preset(
+            "serverless_noniid_medical", hf=args.hf).replace(
+                **common, name="faithful_noniid_medical", faithful=True),
         # the BC-FL stack on the same data: hash-chained ledger payloads,
         # PageRank-gated aggregation, buffered-async rounds
         "bcfl_async_pagerank_medical": get_preset(
@@ -299,6 +309,14 @@ def _mode_ordering_note(summary, out_dir):
     different flags; comparing those would conflate budget with mode."""
     # every --key-suffix re-run contributes its own pair; each is compared
     # only within its own suffix (matching budgets is checked per pair)
+    def _matched(a, b):
+        return a and b and not any(
+            a.get(k) != b.get(k)
+            for k in ("model", "rounds", "seq_len", "hf_weights",
+                      "clients", "max_eval_batches", "eval_every")) \
+            and a.get("final_acc") is not None \
+            and b.get("final_acc") is not None
+
     pairs = []
     for key in sorted(summary):
         if not key.startswith("server_iid_medical"):
@@ -306,20 +324,17 @@ def _mode_ordering_note(summary, out_dir):
         suf = key[len("server_iid_medical"):]
         sv = summary.get("server_iid_medical" + suf)
         sl = summary.get("serverless_noniid_medical" + suf)
-        if not (sv and sl):
+        if not _matched(sv, sl):
             continue
-        if any(sv.get(k) != sl.get(k)
-               for k in ("model", "rounds", "seq_len", "hf_weights",
-                         "clients", "max_eval_batches", "eval_every")):
-            continue
-        if sv.get("final_acc") is None or sl.get("final_acc") is None:
-            continue
-        pairs.append((sv, sl))
+        fa = summary.get("faithful_noniid_medical" + suf)
+        pairs.append((sv, sl, fa if _matched(sv, fa) else None))
     if not pairs:
         return ""
     lines = ["## Mode ordering vs the reference's headline claims", ""]
-    for sv, sl in pairs:
+    for sv, sl, fa in pairs:
         lines += _pair_ordering_lines(sv, sl)
+        if fa:
+            lines += _faithful_lines(sv, sl, fa)
     lines += _worker_pair_lines(out_dir)
     lines.append("")
     return "\n".join(lines)
@@ -370,6 +385,29 @@ def _pair_ordering_lines(sv, sl):
             "105/122/187 vs 280/628/810 min).")
     lines.append("")
     return lines
+
+
+def _faithful_lines(sv, sl, fa):
+    """The reference's serverless AS IT EXECUTES (sequential-shared-model,
+    SURVEY §3.2) vs this repo's independent-clients serverless, at the
+    same matched budget — emitted only when the faithful config was run.
+    Separates the reference's published serverless>server gap into
+    'gossip averaging' vs 'the sequential quirk'."""
+    gap_server = fa["final_acc"] - sv["final_acc"]
+    gap_indep = fa["final_acc"] - sl["final_acc"]
+    verdict = ("the reference's serverless>server accuracy gap REPRODUCES "
+               "under its own sequential semantics"
+               if gap_server > 0 else
+               "even the sequential semantics do not beat server here")
+    return [
+        f"- **Faithful serverless** (the reference's sequential-shared-model "
+        f"execution, SURVEY §3.2, same budget): {fa['final_acc']:.3f} vs "
+        f"server {sv['final_acc']:.3f} ({gap_server:+.3f}) and vs "
+        f"independent-clients serverless {sl['final_acc']:.3f} "
+        f"({gap_indep:+.3f}) — {verdict}. Each faithful round trains "
+        "clients sequentially on one shared model (~clients x more "
+        "sequential optimization per round than independent clients).",
+    ]
 
 
 def _worker_pair_lines(out_dir):

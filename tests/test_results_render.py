@@ -87,6 +87,21 @@ def test_pair_lines_disclose_reduced_iid_draw():
     assert "IID samples" not in text
 
 
+def test_faithful_line_emitted_at_matched_budget(tmp_path):
+    summary = {
+        "server_iid_medical_x": _entry("small-bert", 8, 0.408),
+        "serverless_noniid_medical_x": _entry("small-bert", 8, 0.402),
+        "faithful_noniid_medical_x": _entry("small-bert", 8, 0.47),
+    }
+    note = rr._mode_ordering_note(summary, str(tmp_path))
+    assert "Faithful serverless" in note
+    assert "REPRODUCES under its own sequential semantics" in note
+    # mismatched budget: the faithful line is withheld
+    summary["faithful_noniid_medical_x"]["rounds"] = 20
+    note = rr._mode_ordering_note(summary, str(tmp_path))
+    assert "Faithful serverless" not in note
+
+
 def test_worker_pair_lines_read_artifact(tmp_path):
     import json
 

@@ -856,15 +856,40 @@ class FedEngine:
         round's starting params instead of zeroing the model."""
         cfg = self.cfg
         batches, n_ex = self._round_batches(rnd)
-        host_b = jax.device_get(batches)
         keys = client_round_keys(
             jax.random.fold_in(self.root_key, 4), cfg.num_clients, rnd)
         snapshots, host_snaps, snap_fps, all_stats = [], [], [], []
         fp_mode = self.ledger is not None and self.tamper_hook is None
-        shared = trainable
+        # Pin the sequential path to ONE device when the model fits on one.
+        # The engine holds trainable replicated over the mesh (the r04
+        # steady-state-sharding fix), and jitting the per-client program on
+        # replicated-committed inputs executes EVERY replica — pure
+        # redundant FLOPs on a pod, and an 8x wall-clock multiplier on the
+        # serialized virtual CPU mesh (measured: small-bert x 10 clients,
+        # round 0 went 536 s pinned vs >60 min replicated). The result is
+        # put back into the caller's sharding so the parallel eval/round
+        # programs see their layout. With tp/sp > 1 the model is sharded
+        # BECAUSE it exceeds one device — there the GSPMD path stands.
+        pin = cfg.tp == 1 and cfg.sp == 1
+        if pin:
+            out_sharding = jax.tree.map(lambda x: x.sharding, trainable)
+            dev = jax.local_devices()[0]
+            shared = jax.device_put(trainable, dev)
+            frozen = getattr(self, "_frozen_dev0", None)
+            if frozen is None:
+                frozen = self._frozen_dev0 = jax.device_put(self.frozen, dev)
+            keys = jax.device_put(keys, dev)
+            # one bulk transfer, sliced on-device per client — not a
+            # device_get + per-client re-upload round trip
+            dev_b = jax.device_put(batches, dev)
+        else:
+            shared, frozen = trainable, self.frozen
+            host_b = jax.device_get(batches)
         for c in range(cfg.num_clients):
-            cb = jax.tree.map(lambda x: jnp.asarray(x[c]), host_b)
-            shared, stats = self.progs.single_update(shared, self.frozen, cb, keys[c])
+            cb = (jax.tree.map(lambda x: x[c], dev_b) if pin
+                  else jax.tree.map(lambda x: jnp.asarray(x[c]), host_b))
+            shared, stats = self.progs.single_update(shared, frozen, cb,
+                                                     keys[c])
             if fp_mode:
                 # device-side digest: K floats cross the link, not the tree
                 fence(shared)  # single_update is async; see _ledger_verify
@@ -902,7 +927,7 @@ class FedEngine:
         if total <= 0.0:
             return trainable, rec
         avg = _tree_wsum(jnp.asarray(w / total), snapshots)
-        return avg, rec
+        return (jax.device_put(avg, out_sharding) if pin else avg), rec
 
     # ------------------------------------------------------------------ async
 

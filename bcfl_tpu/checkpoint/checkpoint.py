@@ -1,58 +1,190 @@
-"""Checkpoint/resume via Orbax.
+"""Crash-safe checkpoint/resume via Orbax.
 
 The reference only saves (``global_model.save_pretrained(...)`` every round,
 ``serverless_NonIID_IMDB.py:305`` — doubling as its model-size probe) and has
 no load/resume path at all (SURVEY.md §5). Here a checkpoint is
 ``(round, param state, ledger json, rng seed)`` and :func:`restore_latest`
 actually resumes a run mid-training.
+
+Crash safety (ROBUSTNESS.md):
+
+- **Atomic commit.** The state tree is written to a dot-prefixed staging
+  directory, then renamed into ``round_XXXXXX`` — the single commit point —
+  and only then is the integrity metadata (a SHA-256 params digest via
+  :func:`bcfl_tpu.ledger.ledger.params_digest`, plus the sidecar ledger
+  JSON) fsynced into place. A crash at any instant leaves no ``round_``
+  entry at all (staging names are invisible to the scan), a complete tree
+  pending metadata (restored, unverified — exactly like a legacy
+  checkpoint), or a complete verified one; it can never leave a truncated
+  directory that :func:`restore_latest`'s newest-first scan would pick up,
+  and never a valid tree paired with a MISMATCHING digest (on re-save the
+  stale meta is deleted before the old tree is touched), so the digest
+  check can only ever reject genuine corruption.
+- **Verified restore.** ``restore_latest`` walks checkpoints newest-first,
+  re-derives each candidate's params digest and compares it to the
+  committed metadata; a checkpoint that fails to load (truncated by a
+  pre-atomic writer, half-deleted, ...) or whose digest mismatches (silent
+  bit corruption) is skipped with a warning and the next older valid one
+  is restored — the engine resumes from the last GOOD state instead of
+  crashing on a partial one.
+- **Legacy tolerance.** Checkpoints written before the metadata sidecar
+  existed restore as before (no digest to verify, separate
+  ``ledger_XXXXXX.json`` file honored).
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+logger = logging.getLogger(__name__)
+
+# staging prefix: never matches the `round_` scan, so an interrupted save is
+# invisible to restore_latest until the atomic rename commits it
+_STAGING = ".staging."
+_META_SUFFIX = ".meta.json"
+
 
 def _to_host(tree):
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
+def _state_digest(state) -> str:
+    """Hex SHA-256 over the state tree (leaf names + dtypes + shapes + raw
+    bytes) — the ledger's canonical params digest reused as checkpoint
+    integrity evidence. Computed on the host copy, so the digest of a
+    restored tree reproduces it bit-for-bit."""
+    from bcfl_tpu.ledger.ledger import params_digest
+
+    return params_digest(state).hex()
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record directory-entry changes (renames) — without this the
+    atomic rename can itself be lost by a power cut."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platform without directory fds; best effort
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _meta_path(directory: str, round_idx: int) -> str:
+    return os.path.join(directory, f"round_{round_idx:06d}{_META_SUFFIX}")
+
+
 def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
                     ledger_json: Optional[str] = None) -> str:
-    """Write ``state`` (a pytree of arrays) for ``round_idx``; returns path."""
+    """Atomically write ``state`` (a pytree of arrays) for ``round_idx``;
+    returns the committed path.
+
+    Commit protocol: stage the orbax tree under a scan-invisible name,
+    rename it to ``round_XXXXXX`` (the one atomic commit point), then fsync
+    the metadata sidecar (digest + ledger json) into place. Ordering
+    invariant: a valid tree may transiently lack metadata (restored
+    unverified, like a legacy checkpoint) but is NEVER paired with a
+    mismatching digest — on re-save of an existing round the stale meta is
+    deleted before the old tree is disturbed, so the digest check rejects
+    only genuine corruption."""
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, f"round_{round_idx:06d}")
+    name = f"round_{round_idx:06d}"
+    final = os.path.join(directory, name)
+    staging = os.path.join(directory, _STAGING + name)
+    if os.path.isdir(staging):  # leftover from an interrupted save
+        shutil.rmtree(staging)
+
+    host = _to_host(state)
     with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, _to_host(state), force=True)
-    if ledger_json is not None:
-        with open(os.path.join(directory, f"ledger_{round_idx:06d}.json"), "w") as f:
-            f.write(ledger_json)
-    return path
+        ckptr.save(staging, host, force=True)
+
+    meta_path = _meta_path(directory, round_idx)
+    if os.path.isdir(final):
+        # re-save of the same round: retire the old meta FIRST (the old
+        # tree degrades to unverified, never digest-mismatched), then the
+        # old tree (a crash here falls back to the previous round — the
+        # writer was mid-overwrite, so that is the newest consistent state)
+        if os.path.exists(meta_path):
+            os.unlink(meta_path)
+            _fsync_dir(directory)
+        shutil.rmtree(final)
+    os.replace(staging, final)  # commit point
+    _fsync_dir(directory)
+
+    meta = {"round": int(round_idx), "digest": _state_digest(host),
+            "ledger": ledger_json}
+    meta_staging = os.path.join(directory, _STAGING + name + _META_SUFFIX)
+    with open(meta_staging, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(meta_staging, meta_path)
+    _fsync_dir(directory)
+    return final
+
+
+def _read_meta(directory: str, round_idx: int) -> Optional[Dict[str, Any]]:
+    path = _meta_path(directory, round_idx)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning("checkpoint meta %s unreadable (%s); treating "
+                       "checkpoint as legacy/unverified", path, e)
+        return None
 
 
 def restore_latest(directory: str) -> Optional[Tuple[int, Dict[str, Any], Optional[str]]]:
-    """(round, state, ledger_json) of the newest checkpoint, or None."""
+    """(round, state, ledger_json) of the newest VALID checkpoint, or None.
+
+    Walks checkpoints newest-first; a candidate that fails to restore or
+    whose params digest mismatches its committed metadata is skipped (with
+    a warning) in favor of the next older one — a half-written or corrupted
+    newest checkpoint degrades the resume point by one interval instead of
+    killing the run."""
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return None
     rounds = sorted(
         int(d.split("_")[1]) for d in os.listdir(directory)
         if d.startswith("round_") and d.split("_")[1].isdigit()
+        and os.path.isdir(os.path.join(directory, d))
     )
-    if not rounds:
-        return None
-    r = rounds[-1]
-    with ocp.PyTreeCheckpointer() as ckptr:
-        state = ckptr.restore(os.path.join(directory, f"round_{r:06d}"))
-    ledger_path = os.path.join(directory, f"ledger_{r:06d}.json")
-    ledger_json = None
-    if os.path.exists(ledger_path):
-        with open(ledger_path) as f:
-            ledger_json = f.read()
-    return r, state, ledger_json
+    for r in reversed(rounds):
+        path = os.path.join(directory, f"round_{r:06d}")
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                state = ckptr.restore(path)
+        except Exception as e:  # truncated/partial tree: try the next older
+            logger.warning("checkpoint %s failed to restore (%s); falling "
+                           "back to the previous checkpoint", path, e)
+            continue
+        meta = _read_meta(directory, r)
+        if meta is not None and meta.get("digest"):
+            if _state_digest(state) != meta["digest"]:
+                logger.warning(
+                    "checkpoint %s params digest mismatch (bit corruption "
+                    "or foreign overwrite); falling back to the previous "
+                    "checkpoint", path)
+                continue
+        ledger_json = meta.get("ledger") if meta is not None else None
+        if ledger_json is None:
+            # pre-metadata layout: ledger in its own sidecar file
+            legacy = os.path.join(directory, f"ledger_{r:06d}.json")
+            if os.path.exists(legacy):
+                with open(legacy) as f:
+                    ledger_json = f.read()
+        return r, state, ledger_json
+    return None

@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+from bcfl_tpu.faults import FaultPlan
+
 
 @dataclasses.dataclass(frozen=True)
 class PartitionConfig:
@@ -179,6 +181,26 @@ class FedConfig:
     # True  = example-weighted FedAvg (Flower's aggregate, server mode)
     # False = unweighted mean (reference serverless ":296" semantics)
     weighted_agg: bool = True
+    # Byzantine-robust aggregation rule, compiled INTO the round programs
+    # (ROBUSTNESS.md). "mean" is the reference behaviour; the robust rules
+    # are per-coordinate order statistics / update selection over the
+    # PARTICIPATING clients (mask/auth-aware) and deliberately ignore
+    # example weighting (weighted_agg) — order statistics have no sound
+    # notion of fractional votes:
+    #   trimmed_mean — drop the ceil(aggregator_trim * k) highest and lowest
+    #                  values per coordinate, mean the rest,
+    #   median       — coordinate-wise median of participating updates,
+    #   krum         — select the single update closest to its k-f-2 nearest
+    #                  neighbours (f = ceil(aggregator_trim * k)).
+    # In sync="async" mode the participation-only rule also flattens the
+    # PER-CLIENT staleness decay inside the merge (a stale arrival votes at
+    # full strength); the global step-size rescale (_async_merge_scale)
+    # still shrinks the applied delta, so staleness dampens the step, not
+    # the vote. gspmd impl only (the default); impl="shard_map" supports
+    # "mean" only.
+    aggregator: str = "mean"
+    # assumed Byzantine fraction for trimmed_mean/krum, in [0, 0.5)
+    aggregator_trim: float = 0.2
     # faithful=True reproduces the reference serverless quirk where clients
     # sequentially mutate ONE shared model within a round
     # (serverless_NonIID_IMDB.py:288 — see SURVEY.md §3.2)
@@ -200,6 +222,9 @@ class FedConfig:
     partition: PartitionConfig = dataclasses.field(default_factory=PartitionConfig)
     topology: TopologyConfig = dataclasses.field(default_factory=TopologyConfig)
     ledger: LedgerConfig = dataclasses.field(default_factory=LedgerConfig)
+    # fault-injection schedule (bcfl_tpu.faults, ROBUSTNESS.md); the default
+    # plan injects nothing
+    faults: FaultPlan = dataclasses.field(default_factory=FaultPlan)
 
     # --- checkpoint / metrics ---
     checkpoint_dir: Optional[str] = None
@@ -245,6 +270,29 @@ class FedConfig:
             raise ValueError(
                 f"seq_len {self.seq_len} must be divisible by sp={self.sp} "
                 "(ring attention shards the sequence into sp equal blocks)")
+        if self.aggregator not in ("mean", "trimmed_mean", "median", "krum"):
+            raise ValueError(
+                "aggregator must be mean/trimmed_mean/median/krum, "
+                f"got {self.aggregator!r}")
+        if not 0.0 <= self.aggregator_trim < 0.5:
+            # >= 0.5 would trim every client (2t >= k) / assume a Byzantine
+            # majority, which no aggregation rule can survive
+            raise ValueError(
+                f"aggregator_trim must be in [0, 0.5), got "
+                f"{self.aggregator_trim}")
+        if self.faults.corrupts and self.faithful:
+            raise ValueError(
+                "FaultPlan corruption models transport of the parallel "
+                "paths' stacked updates; faithful (host-sequential) mode "
+                "has no transport stage — use the tamper_hook shim there")
+        if self.aggregator != "mean" and self.faithful:
+            # the faithful path averages snapshots host-side with a plain
+            # weighted sum; silently running that under a robust-aggregator
+            # label would fake Byzantine protection
+            raise ValueError(
+                f"aggregator={self.aggregator!r} is not implemented for "
+                "faithful (host-sequential) mode — it always aggregates "
+                "with the reference's plain mean")
         if self.tp > 1 and self.lora_rank <= 0:
             raise ValueError(
                 "tp > 1 tensor-shards the FROZEN base and keeps per-client "

@@ -1,0 +1,29 @@
+"""jax version-compatibility shims.
+
+The codebase targets the post-0.6 public API surface (``jax.shard_map`` with
+``check_vma``); older installs still ship ``shard_map`` under
+``jax.experimental.shard_map`` with the same semantics behind the
+``check_rep`` keyword. Import :func:`shard_map` from here instead of from
+``jax`` so one module owns the dispatch — on a current jax this is a pure
+pass-through.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: public API, check_vma keyword
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax < 0.6: experimental home, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kw):
+    """``jax.shard_map`` across jax versions. Accepts the modern
+    ``check_vma`` keyword and translates it for installs whose shard_map
+    still calls it ``check_rep``."""
+    if "check_vma" in kw and _CHECK_KW != "check_vma":
+        kw[_CHECK_KW] = kw.pop("check_vma")
+    return _shard_map(f, **kw)

@@ -105,6 +105,35 @@ def main(argv=None):
                          "loudly instead of being ignored")
     ap.add_argument("--ledger", action="store_true",
                     help="enable the hash-chained weight ledger (BC-FL)")
+    ap.add_argument("--aggregator", default=None,
+                    choices=["mean", "trimmed_mean", "median", "krum"],
+                    help="aggregation rule compiled into the round program "
+                         "(ROBUSTNESS.md): mean = reference FedAvg; the "
+                         "robust rules survive up to an aggregator-trim "
+                         "fraction of Byzantine clients without the ledger")
+    ap.add_argument("--aggregator-trim", type=float, default=None,
+                    help="assumed Byzantine fraction for trimmed_mean/krum "
+                         "(default 0.2, must be < 0.5)")
+    # chaos harness (bcfl_tpu.faults.FaultPlan, ROBUSTNESS.md): seeded,
+    # deterministic fault injection — the resilience demo knobs
+    ap.add_argument("--chaos-dropout", type=float, default=None,
+                    metavar="P", help="per-round per-client dropout "
+                    "probability (fault injection)")
+    ap.add_argument("--chaos-straggler", type=float, default=None,
+                    metavar="P", help="per-round per-client straggler "
+                    "probability (simulated-clock delay)")
+    ap.add_argument("--chaos-straggler-delay", type=float, default=30.0,
+                    metavar="SECONDS", help="injected straggler delay")
+    ap.add_argument("--chaos-corrupt", type=float, default=None,
+                    metavar="P", help="per-round per-client transport-"
+                    "corruption probability; with --ledger corrupted "
+                    "updates fail auth, without it use a robust "
+                    "--aggregator")
+    ap.add_argument("--chaos-crash-round", type=int, default=None,
+                    metavar="N", help="inject a host crash at round N "
+                    "(resume afterwards with --resume)")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed of the chaos schedule (independent of --seed)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=None)
     ap.add_argument("--platform", default=None,
@@ -161,6 +190,23 @@ def main(argv=None):
         overrides["ledger"] = dataclasses.replace(cfg.ledger, enabled=True)
     if args.pod:
         overrides["pod"] = True
+    if args.aggregator is not None:
+        overrides["aggregator"] = args.aggregator
+    if args.aggregator_trim is not None:
+        overrides["aggregator_trim"] = args.aggregator_trim
+    if (args.chaos_dropout is not None or args.chaos_straggler is not None
+            or args.chaos_corrupt is not None
+            or args.chaos_crash_round is not None):
+        from bcfl_tpu.faults import FaultPlan
+
+        overrides["faults"] = FaultPlan(
+            seed=args.chaos_seed,
+            dropout_prob=args.chaos_dropout or 0.0,
+            straggler_prob=args.chaos_straggler or 0.0,
+            straggler_delay_s=args.chaos_straggler_delay,
+            corrupt_prob=args.chaos_corrupt or 0.0,
+            crash_at_round=args.chaos_crash_round,
+        )
     cfg = cfg.replace(**overrides)
 
     fused_tamper = None

@@ -46,8 +46,12 @@ def _round_line(r) -> str:
     rejected = ([i for i, a in enumerate(r.auth) if a == 0.0]
                 if r.auth else [])
     rej = f" auth_failed={rejected}" if rejected else ""
+    # chaos-harness observability (bcfl_tpu.faults): injected dropout and an
+    # all-eliminated (model-kept) round must be visible in the stream
+    drop = f" dropped={r.dropped}" if r.dropped else ""
+    deg = " DEGRADED" if r.degraded else ""
     return (f"round {r.round:3d}: train_loss={r.train_loss:.4f} "
-            f"train_acc={r.train_acc:.4f}{acc}{anom}{rej} "
+            f"train_acc={r.train_acc:.4f}{acc}{anom}{rej}{drop}{deg} "
             f"wall={r.wall_s:.2f}s")
 
 

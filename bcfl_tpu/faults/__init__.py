@@ -1,0 +1,5 @@
+from bcfl_tpu.faults.plan import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    SimulatedCrash,
+)

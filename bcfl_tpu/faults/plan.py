@@ -1,0 +1,220 @@
+"""Config-driven fault injection — the chaos layer.
+
+The paper's pitch is decentralized FL that survives bad actors and bad
+networks (the anomaly gating and the hash-chained ledger exist exactly for
+that), yet until this module the engine could only be *attacked* through two
+ad-hoc hooks (``tamper_hook`` host-tree tampering, ``fused_tamper`` in-graph
+transport scales) and never *stressed*: no client dropout, no stragglers, no
+host crashes. :class:`FaultPlan` turns those implicit failure assumptions
+into one seeded, deterministic, config-level schedule:
+
+- **dropout** — per-round Bernoulli client dropout, composed into the
+  participation mask exactly like an anomaly-filter exclusion (the mesh
+  shape never changes; dropped clients carry weight 0),
+- **stragglers** — per-round simulated-clock delays, fed into
+  :meth:`bcfl_tpu.topology.graph.LatencyGraph.info_passing_time` (sync
+  accounting) and added to the async engine's per-client completion clock
+  (so a straggler genuinely accumulates staleness),
+- **corruption** — in-flight update corruption: per-round per-client
+  additive scales applied to the *transported* copy of each update, the one
+  API behind both legacy hooks (see :class:`FaultInjector`). With the ledger
+  on, commit fingerprints are taken before transport and verification after,
+  so corrupted clients fail authentication and are excluded; without the
+  ledger, the robust aggregators (``FedConfig.aggregator``) are the defense,
+- **crash** — kill the round loop at a chosen round
+  (:class:`SimulatedCrash`); a restart with ``resume=True`` must reproduce
+  the uninterrupted run bit-for-bit (tests/test_faults.py pins this).
+
+Everything is derived from ``(seed, fault lane, round)`` via
+``np.random.default_rng`` — two engines with equal plans draw identical
+fault schedules, which is what makes crash/resume and A/B comparisons
+meaningful. The plan is a frozen dataclass so it can live inside
+:class:`bcfl_tpu.config.FedConfig` (hashable, comparable, replace()-able).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by the engine when a :class:`FaultPlan` schedules a host crash.
+
+    Carries ``round`` so harnesses can assert where the run died before
+    restarting it from the last checkpoint."""
+
+    def __init__(self, round_idx: int):
+        super().__init__(
+            f"FaultPlan injected a host crash at round {round_idx}")
+        self.round = round_idx
+
+
+# fault lanes: each fault class draws from its own RNG stream so enabling
+# one never perturbs another's schedule (a dropout sweep must not reshuffle
+# which clients get corrupted)
+_LANE_DROPOUT = 1
+_LANE_STRAGGLER = 2
+_LANE_CORRUPT = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Deterministic per-round fault schedule. The all-defaults plan injects
+    nothing (``enabled`` is False) — it is the no-op value every config
+    carries.
+
+    ``*_rounds`` fields restrict a fault class to an explicit round tuple
+    (None = every round); probabilities are per-client Bernoulli draws from
+    the seeded stream. ``dropout_prob=1.0`` with ``dropout_rounds=(k,)`` is
+    the deterministic "every client vanishes in round k" scenario the
+    degraded-round handling exists for."""
+
+    seed: int = 0
+    # client dropout: each client independently sits the round out
+    dropout_prob: float = 0.0
+    dropout_rounds: Optional[Tuple[int, ...]] = None
+    # stragglers: affected clients finish `straggler_delay_s` late
+    straggler_prob: float = 0.0
+    straggler_delay_s: float = 30.0
+    straggler_rounds: Optional[Tuple[int, ...]] = None
+    # transport corruption: affected clients' shipped updates arrive with
+    # `corrupt_scale` added to every parameter (the fused `_transport`
+    # semantics — an exact float perturbation, never a silent no-op)
+    corrupt_prob: float = 0.0
+    corrupt_scale: float = 1e6
+    corrupt_rounds: Optional[Tuple[int, ...]] = None
+    # host crash: the engine raises SimulatedCrash at the START of this
+    # round (anything checkpointed before it survives; nothing after runs).
+    # Models ONE host failure: a resumed run (``engine.run(resume=True)``)
+    # does not re-fire it — resume restarts at or before the crash round,
+    # so re-firing would make the crash -> resume workflow unpassable
+    crash_at_round: Optional[int] = None
+
+    def __post_init__(self):
+        for name in ("dropout_prob", "straggler_prob", "corrupt_prob"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {p}")
+        if self.straggler_delay_s < 0:
+            raise ValueError(
+                f"straggler_delay_s must be >= 0, got {self.straggler_delay_s}")
+        if not np.isfinite(self.corrupt_scale):
+            raise ValueError("corrupt_scale must be finite (NaN/Inf would "
+                             "poison the fingerprint comparison itself)")
+        for name in ("dropout_rounds", "straggler_rounds", "corrupt_rounds"):
+            r = getattr(self, name)
+            if r is not None and not isinstance(r, tuple):
+                raise ValueError(
+                    f"{name} must be a tuple of round indices (hashable — "
+                    f"the plan lives inside the frozen FedConfig), got "
+                    f"{type(r).__name__}")
+        if self.crash_at_round is not None and self.crash_at_round < 0:
+            raise ValueError(
+                f"crash_at_round must be >= 0, got {self.crash_at_round}")
+
+    # ------------------------------------------------------------------ query
+
+    @property
+    def enabled(self) -> bool:
+        return (self.dropout_prob > 0 or self.straggler_prob > 0
+                or self.corrupt_prob > 0 or self.crash_at_round is not None)
+
+    @property
+    def corrupts(self) -> bool:
+        return self.corrupt_prob > 0
+
+    def _rng(self, lane: int, rnd: int) -> np.random.Generator:
+        return np.random.default_rng((self.seed, lane, rnd))
+
+    def _due(self, rounds: Optional[Tuple[int, ...]], rnd: int) -> bool:
+        return rounds is None or rnd in rounds
+
+    # ------------------------------------------------------------- per-round
+
+    def dropout_keep(self, rnd: int, num_clients: int) -> Optional[np.ndarray]:
+        """[C] float 0/1 keep-mask (0 = client sits this round out), or None
+        when dropout is not scheduled for ``rnd``."""
+        if self.dropout_prob <= 0 or not self._due(self.dropout_rounds, rnd):
+            return None
+        draw = self._rng(_LANE_DROPOUT, rnd).random(num_clients)
+        return (draw >= self.dropout_prob).astype(np.float32)
+
+    def straggler_delays(self, rnd: int,
+                         num_clients: int) -> Optional[np.ndarray]:
+        """[C] float seconds of extra completion delay, or None when no
+        straggler is scheduled for ``rnd``."""
+        if self.straggler_prob <= 0 or not self._due(self.straggler_rounds,
+                                                     rnd):
+            return None
+        draw = self._rng(_LANE_STRAGGLER, rnd).random(num_clients)
+        delays = np.where(draw < self.straggler_prob,
+                          self.straggler_delay_s, 0.0)
+        return delays.astype(np.float64) if delays.any() else None
+
+    def transport_scales(self, rnd: int,
+                         num_clients: int) -> Optional[np.ndarray]:
+        """[C] float32 additive transport-corruption scales (0 = clean), or
+        None when no corruption is scheduled for ``rnd``."""
+        if self.corrupt_prob <= 0 or not self._due(self.corrupt_rounds, rnd):
+            return None
+        draw = self._rng(_LANE_CORRUPT, rnd).random(num_clients)
+        row = np.where(draw < self.corrupt_prob, self.corrupt_scale, 0.0)
+        return row.astype(np.float32) if row.any() else None
+
+    def should_crash(self, rnd: int) -> bool:
+        return self.crash_at_round is not None and rnd == self.crash_at_round
+
+
+class FaultInjector:
+    """Binds a :class:`FaultPlan` to one engine run (fixed client count) and
+    hosts the two legacy corruption hooks as deprecated shims:
+
+    - ``host_tamper`` (né ``tamper_hook``): ``(rnd, host_stacked) -> tree``
+      byte-level tampering of HOST trees — forces the faithful full
+      byte-hash ledger flow and the per-round path,
+    - ``fused_tamper``: ``(rnd) -> [C] scales or None`` — in-graph transport
+      corruption for FUSED dispatches only (a request landing on a
+      per-round-path round still fails loudly, engine semantics unchanged).
+
+    New code expresses corruption through ``FaultPlan.corrupt_*``, which
+    works on BOTH the per-round path (split-phase commit -> transport ->
+    verify) and composes with every aggregator. The engine consults only
+    this adapter, so the three corruption sources cannot drift apart.
+    """
+
+    def __init__(self, plan: Optional[FaultPlan], num_clients: int,
+                 host_tamper: Optional[Callable] = None,
+                 fused_tamper: Optional[Callable] = None):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.num_clients = int(num_clients)
+        self.host_tamper = host_tamper
+        self.fused_tamper = fused_tamper
+        if self.plan.corrupts and host_tamper is not None:
+            raise ValueError(
+                "FaultPlan corruption and the legacy tamper_hook are two "
+                "transport models for the same updates — pick one (the "
+                "tamper_hook shim exists only for byte-level host tampering)")
+
+    # thin per-round delegates (client count already bound)
+    def dropout_keep(self, rnd: int) -> Optional[np.ndarray]:
+        return self.plan.dropout_keep(rnd, self.num_clients)
+
+    def straggler_delays(self, rnd: int) -> Optional[np.ndarray]:
+        return self.plan.straggler_delays(rnd, self.num_clients)
+
+    def transport_scales(self, rnd: int) -> Optional[np.ndarray]:
+        return self.plan.transport_scales(rnd, self.num_clients)
+
+    def should_crash(self, rnd: int) -> bool:
+        return self.plan.should_crash(rnd)
+
+    def blocks_fusion(self) -> bool:
+        """Any scheduled plan fault forces the per-round path: dropout
+        perturbs the mask, stragglers and crashes need the host clock/loop
+        between rounds, and plan corruption runs the split-phase transport
+        stage (the fused in-graph stage remains reachable via the
+        ``fused_tamper`` shim, which does not block fusion)."""
+        return self.plan.enabled

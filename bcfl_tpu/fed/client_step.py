@@ -29,9 +29,10 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from bcfl_tpu.core.compat import shard_map
 from bcfl_tpu.core.mesh import ClientMesh
 from bcfl_tpu.ledger.fingerprint import client_fingerprint, tree_fingerprint
 from bcfl_tpu.models import lora as lora_lib
@@ -216,6 +217,11 @@ class FedPrograms:
     # content fingerprints so the ledger never pulls the full tree to host:
     fingerprint: Optional[Callable] = None  # stacked client_t -> [C, K]
     fingerprint_one: Optional[Callable] = None  # trainable -> [K]
+    # transport-aware serverless mix for the split-phase corruption flow
+    # (faults.FaultPlan): (self_t, recv_t, mask, start_t) -> client_t —
+    # neighbor/aggregate terms from the TRANSPORTED tree, self-terms from
+    # the honest local tree (gspmd impl only)
+    mix_recv: Optional[Callable] = None
     # fused-round twins that ALSO emit each round's per-client update
     # fingerprints [R, C, K] (gspmd impl only — the ledger can then fuse):
     server_rounds_fp: Optional[Callable] = None
@@ -233,6 +239,12 @@ def build_programs(
     gossip_alpha: float = 0.5,
     gossip_steps: int = 1,
     task: str = "classification",
+    # Byzantine-robust aggregation rule (parallel.gspmd.AGGREGATORS,
+    # ROBUSTNESS.md). A build-time static: each choice is its own compiled
+    # program, so switching it never retraces inside a run. gspmd impl only;
+    # shard_map supports "mean".
+    aggregator: str = "mean",
+    aggregator_trim: float = 0.2,
     # typed-key impl for the stacked per-client rngs: None follows jax's
     # process default; "rbg" opts into the TPU hardware generator
     # (dropout RNG is +38% of step time under threefry, PERF.md)
@@ -266,7 +278,8 @@ def build_programs(
         # ClientMesh is a frozen dataclass: hashing the instance covers every
         # mesh field, including any added later that changes program layout
         key = (model, mesh, optimizer, learning_rate, max_grad_norm,
-               gossip_alpha, gossip_steps, task, prng_impl, donate, impl)
+               gossip_alpha, gossip_steps, task, aggregator, aggregator_trim,
+               prng_impl, donate, impl)
         hash(key)
     except TypeError:
         key = None
@@ -278,6 +291,7 @@ def build_programs(
         model, mesh, optimizer=optimizer, learning_rate=learning_rate,
         max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
         gossip_steps=gossip_steps, donate=donate, task=task,
+        aggregator=aggregator, aggregator_trim=aggregator_trim,
         prng_impl=prng_impl, impl=impl)
     if key is not None:
         while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
@@ -308,6 +322,8 @@ def _build_programs_dispatch(
     gossip_alpha: float,
     gossip_steps: int,
     task: str,
+    aggregator: str,
+    aggregator_trim: float,
     prng_impl: Optional[str],
     donate: bool,
     impl: str,
@@ -317,9 +333,19 @@ def _build_programs_dispatch(
             model, mesh, optimizer=optimizer, learning_rate=learning_rate,
             max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
             gossip_steps=gossip_steps, donate=donate, task=task,
+            aggregator=aggregator, aggregator_trim=aggregator_trim,
             prng_impl=prng_impl)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
+    if aggregator != "mean":
+        # the robust rules are order statistics over the GLOBAL client dim;
+        # inside a shard_map body each device sees only its local stack, so
+        # a faithful manual-SPMD form needs an all-gather the twin deliberately
+        # avoids — only the GSPMD programs compile them today
+        raise ValueError(
+            f"aggregator={aggregator!r} requires impl='gspmd' (unset "
+            "BCFL_FED_IMPL or set it to 'gspmd'); the shard_map twin "
+            "implements 'mean' only")
     if getattr(mesh, "tp", 1) > 1:
         # the manual-SPMD twin would replicate each client's compute over the
         # tp axis instead of sharding it; only GSPMD composes clients x tp
@@ -600,13 +626,23 @@ def _build_programs_gspmd(
     gossip_steps: int = 1,
     donate: bool = False,
     task: str = "classification",
+    aggregator: str = "mean",
+    aggregator_trim: float = 0.2,
     prng_impl: Optional[str] = None,
 ) -> FedPrograms:
     """GSPMD twin of the shard_map builder: identical program signatures and
     semantics (global stacked-client arrays in, global arrays out), but the
     bodies are plain global-array math under ``jit`` with sharding
     annotations — reductions/rolls over the sharded client dim become XLA
-    all-reduce / collective-permute (:mod:`bcfl_tpu.parallel.gspmd`)."""
+    all-reduce / collective-permute (:mod:`bcfl_tpu.parallel.gspmd`).
+
+    ``aggregator`` swaps the masked weighted mean for a Byzantine-robust
+    rule at every aggregation point that consumes a full stacked-client
+    view: server FedAvg (per-round and fused), the consensus ``collapse``,
+    and the serverless exact-mean (``gossip_steps == 0``). Ring-gossip
+    diffusion (``gossip_steps > 0``) keeps its pairwise mixing rule — a
+    two-neighbour exchange has no order statistics to harden."""
+    agg = gspmd.make_aggregator(aggregator, aggregator_trim)
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model, task)
     unstack = lambda r: _unstack_rng(r, prng_impl)  # noqa: E731
@@ -631,7 +667,7 @@ def _build_programs_gspmd(
 
     def server_body(global_t, frozen, batches, weights, rngs):
         new_t, stats = train_clients(global_t, frozen, batches, rngs)
-        avg = gspmd.masked_weighted_mean(new_t, weights, fallback=global_t)
+        avg = agg(new_t, weights, global_t)
         return _c(avg, repl), stats
 
     server_round = jax.jit(server_body, donate_argnums=_don(0),
@@ -680,11 +716,9 @@ def _build_programs_gspmd(
                 new_t, stats = train_clients(t, frozen, b, r)
                 if with_fp:
                     sent_t, fpc, fpr, auth = _fp_auth(new_t, rest[0])
-                    avg = _c(gspmd.masked_weighted_mean(
-                        sent_t, w * auth, fallback=t), repl)
+                    avg = _c(agg(sent_t, w * auth, t), repl)
                     return avg, (stats, fpc, fpr, auth)
-                avg = _c(gspmd.masked_weighted_mean(new_t, w, fallback=t),
-                         repl)
+                avg = _c(agg(new_t, w, t), repl)
                 return avg, stats
 
             xs = (weights, rngs) if static else (batches, weights, rngs)
@@ -701,9 +735,10 @@ def _build_programs_gspmd(
     server_rounds_static_fp = _make_server_rounds(static=True, with_fp=True)
 
     def _mix_g(new_t, mask, fallback):
-        # same semantics as the shard_map _mix (see its docstring)
+        # same semantics as the shard_map _mix (see its docstring); the
+        # exact-mean path rides the configured aggregator
         if gossip_steps == 0:
-            avg = gspmd.masked_weighted_mean(new_t, mask, fallback=fallback)
+            avg = agg(new_t, mask, fallback)
             return _exact_mean_spread(avg, new_t, mask)
         return gspmd.gossip_mix(new_t, mask, gossip_alpha, steps=gossip_steps)
 
@@ -713,7 +748,7 @@ def _build_programs_gspmd(
         # state) from the client's own honest post-train tree — in-flight
         # corruption must not rewrite the sender's local copy
         if gossip_steps == 0:
-            avg = gspmd.masked_weighted_mean(recv_t, mask, fallback=fallback)
+            avg = agg(recv_t, mask, fallback)
             return _exact_mean_spread(avg, self_t, mask)
         return gspmd.gossip_mix_recv(self_t, recv_t, mask, gossip_alpha,
                                      steps=gossip_steps)
@@ -776,6 +811,11 @@ def _build_programs_gspmd(
         lambda client_t, mask, fallback: _c(_mix_g(client_t, mask, fallback), cl),
         out_shardings=cl)
 
+    mix_recv = jax.jit(
+        lambda self_t, recv_t, mask, fallback: _c(
+            _mix_g_recv(self_t, recv_t, mask, fallback), cl),
+        out_shardings=cl)
+
     single_update = jax.jit(local_train)
 
     eval_one = make_eval_one(loss_fn)
@@ -794,8 +834,7 @@ def _build_programs_gspmd(
     broadcast = make_broadcast(mesh)
 
     collapse = jax.jit(
-        lambda t, w, fallback: _c(
-            gspmd.masked_weighted_mean(t, w, fallback=fallback), repl),
+        lambda t, w, fallback: _c(agg(t, w, fallback), repl),
         out_shardings=repl)
 
     return FedPrograms(
@@ -822,4 +861,5 @@ def _build_programs_gspmd(
         server_rounds_static_fp=server_rounds_static_fp,
         gossip_rounds_fp=gossip_rounds_fp,
         gossip_rounds_static_fp=gossip_rounds_static_fp,
+        mix_recv=mix_recv,
     )

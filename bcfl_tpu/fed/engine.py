@@ -16,17 +16,28 @@ Replaces both reference orchestrators with one config-driven loop
 Per round the host control plane:
 1. runs the anomaly filter over the latency graph -> participation mask
    (reference: offline notebook cells, never wired in — here it gates psum),
+   composed with the fault plan's injected client dropout,
 2. (ledger mode) commits each client's update digest to the hash chain,
-   re-verifies digests, and zeroes the mask of any client whose shipped
-   update fails authentication (fault injection hook: ``tamper_hook``),
-3. launches the compiled round program on the mesh,
-4. records the reference metric set + info-passing times.
+   simulates transport (the fault plan's corruption stage), re-verifies
+   digests, and zeroes the mask of any client whose shipped update fails
+   authentication,
+3. launches the compiled round program on the mesh (aggregation rule =
+   ``cfg.aggregator``: mean or a Byzantine-robust statistic, ROBUSTNESS.md),
+4. records the reference metric set + info-passing times (straggler delays
+   from the fault plan included).
+
+Fault injection (dropout / stragglers / corruption / host crash) is driven
+by ``cfg.faults`` (:class:`bcfl_tpu.faults.FaultPlan`); an all-eliminated
+round keeps the previous global model and is recorded ``degraded`` instead
+of emitting a 0/0 NaN mean.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
+import warnings
 from typing import Callable, Dict, Optional
 
 import jax
@@ -45,6 +56,7 @@ from bcfl_tpu.data import (
     load_dataset,
 )
 from bcfl_tpu.data.pipeline import central_eval_batches
+from bcfl_tpu.faults import FaultInjector, SimulatedCrash
 from bcfl_tpu.fed.client_step import FedPrograms, build_programs, _merge
 from bcfl_tpu.ledger import Ledger
 from bcfl_tpu.ledger import fingerprint as fp_lib
@@ -84,6 +96,17 @@ _tree_select = jax.jit(
 _tree_wsum = jax.jit(
     lambda ws, trees: jax.tree.map(
         lambda *xs: sum(w * x for w, x in zip(ws, xs)), *trees))
+# simulated transport of a stacked update tree on the per-round path: the
+# buffer that "arrives" is new_t + scale per client (0 = clean, an exact
+# float identity) — the same corruption model the fused *_fp programs apply
+# in-graph (client_step._transport), so per-round and fused chaos runs are
+# comparable
+_tree_corrupt = jax.jit(
+    lambda t, s: jax.tree.map(
+        lambda x: x + s.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype),
+        t))
+
+logger = logging.getLogger(__name__)
 
 
 class FedEngine:
@@ -95,15 +118,21 @@ class FedEngine:
         fused_tamper: Optional[Callable] = None,
     ):
         self.cfg = cfg
-        self.tamper_hook = tamper_hook
-        # fused-mode transport corruption: ``fused_tamper(rnd) -> [C] float
-        # scales or None`` perturbs the round's updates INSIDE the fused
-        # dispatch, after ledger commit and before aggregation (the in-graph
-        # simulated-transport stage, client_step._fp_auth). Unlike
         # ``tamper_hook`` (host-tree byte tampering, forces the per-round
-        # path) it composes with fusion — it exists to prove fused-mode auth
-        # can actually fail.
-        self.fused_tamper = fused_tamper
+        # path) and ``fused_tamper`` ((rnd) -> [C] scales, in-graph transport
+        # corruption for fused dispatches) are DEPRECATED shims over the
+        # FaultPlan corruption API (bcfl_tpu.faults): new code schedules
+        # corruption via cfg.faults, which works on both paths and composes
+        # with every aggregator. The shims stay so existing tests/scripts
+        # keep their exact semantics.
+        if tamper_hook is not None or fused_tamper is not None:
+            warnings.warn(
+                "tamper_hook/fused_tamper are deprecated shims — schedule "
+                "corruption via FedConfig.faults (bcfl_tpu.faults.FaultPlan)",
+                DeprecationWarning, stacklevel=2)
+        self.faults = FaultInjector(
+            cfg.faults, cfg.num_clients,
+            host_tamper=tamper_hook, fused_tamper=fused_tamper)
         self.root_key = jax.random.key(cfg.seed, impl=cfg.prng_impl)
         # RESOLVED key impl: with prng_impl=None the run follows jax's
         # process default, which env vars can change — checkpoints must
@@ -227,12 +256,26 @@ class FedEngine:
             gossip_alpha=cfg.topology.gossip_alpha,
             gossip_steps=cfg.topology.gossip_steps,
             task=cfg.task,
+            aggregator=cfg.aggregator,
+            aggregator_trim=cfg.aggregator_trim,
             prng_impl=cfg.prng_impl,
             donate=cfg.donate,
         )
+        if (self.faults.plan.corrupts and cfg.mode == "serverless"
+                and cfg.sync != "async" and self.progs.mix_recv is None):
+            # async is exempt: _async_round never mixes — `sent` feeds only
+            # the delta merge, and each sender's carried state stays honest
+            # without the transport-aware mix the corrupted copy would
+            # REPLACE the sender's own carried state — the next round it
+            # would honestly commit (and pass authentication for) garbage
+            # params, diverging through a path the fault model says cannot
+            # exist. Only the gspmd programs compile mix_recv today.
+            raise ValueError(
+                "serverless FaultPlan corruption requires the gspmd fed "
+                "impl (mix_recv): the shard_map twin has no transport-aware "
+                "mix, so in-flight corruption would poison the sender's own "
+                "carried state (unset BCFL_FED_IMPL or set it to 'gspmd')")
         if cfg.donate and (cfg.sync == "async" or cfg.faithful):
-            import warnings
-
             warnings.warn(
                 "donate=True has no effect on the async/faithful paths — "
                 "they run only undonated split-phase programs, so peak HBM "
@@ -317,7 +360,8 @@ class FedEngine:
         """Authenticate what 'arrived' against the already-committed chain
         (tamper_hook simulates in-flight modification). Returns 0/1 auth mask."""
         C = self.cfg.num_clients
-        shipped = self.tamper_hook(rnd, host) if self.tamper_hook else host
+        tamper = self.faults.host_tamper
+        shipped = tamper(rnd, host) if tamper else host
         auth = np.ones((C,), np.float32)
         for c in range(C):
             ok = self.ledger.authenticate(rnd, c, jax.tree.map(lambda x: x[c], shipped))
@@ -362,8 +406,16 @@ class FedEngine:
             else 0.0
             for c in range(self.cfg.num_clients)], np.float32)
 
-    def _ledger_verify(self, rnd: int, stacked) -> np.ndarray:
-        """Commit every client's update, then authenticate. Returns auth mask.
+    def _ledger_verify(self, rnd: int, stacked, sent=None) -> np.ndarray:
+        """Commit every client's update, then authenticate what arrived.
+        Returns the 0/1 auth mask.
+
+        ``stacked`` is the honest post-train tree each client COMMITS;
+        ``sent`` (default: the same buffer) is the tree that survived the
+        simulated transport stage and is about to be aggregated. When the
+        fault plan corrupts transport the two differ, and authentication
+        genuinely fails for exactly the corrupted clients — the per-round
+        twin of the fused ``*_fp`` programs' in-graph commit/verify split.
 
         Default path: the content digest is a device-side fingerprint
         (:mod:`bcfl_tpu.ledger.fingerprint`) — only ``[C, K]`` floats cross
@@ -378,9 +430,9 @@ class FedEngine:
         # the ledger (observed: a "90% ledger" reading that was ~95%
         # training wait). Must be core.fence — on the tunnelled backend
         # block_until_ready returns before the device finishes
-        fence(stacked)
+        fence(stacked if sent is None else sent)
         with self.clock.phase("ledger"):
-            if self.tamper_hook is not None:
+            if self.faults.host_tamper is not None:
                 host = jax.device_get(stacked)
                 for c in range(C):
                     self.ledger.append(rnd, c,
@@ -388,12 +440,41 @@ class FedEngine:
                 return self._ledger_authenticate(rnd, host)
             fp = np.asarray(self.progs.fingerprint(stacked))
             self._ledger_commit_rows(rnd, "stacked", fp)
-            # authenticate what is about to be aggregated by re-deriving each
-            # digest from the fingerprint; the device arrays are immutable,
-            # so re-running the fingerprint program would reproduce `fp`
-            # bit-for-bit — committing and aggregating the same HBM buffer
-            # is what makes auth an identity here (no transport in-sim)
-            return self._ledger_auth_rows(rnd, "stacked", fp)
+            if sent is None or sent is stacked:
+                # the committed HBM buffer IS the aggregated one: re-running
+                # the fingerprint program would reproduce `fp` bit-for-bit
+                # (device arrays are immutable), so auth re-derives digests
+                # from it directly
+                return self._ledger_auth_rows(rnd, "stacked", fp)
+            fp_recv = np.asarray(self.progs.fingerprint(sent))
+            return self._ledger_auth_rows(rnd, "stacked", fp_recv)
+
+    # ------------------------------------------------------- fault utilities
+
+    def _transport(self, stacked, scales):
+        """Simulated transport of the round's stacked updates: returns the
+        tree that 'arrives' at aggregation. Identity (the same buffer) when
+        ``scales`` is None — callers draw the round's schedule ONCE via
+        ``faults.transport_scales(rnd)`` and thread it here, so the
+        'is corruption scheduled' decision and the scales actually applied
+        can never come from different draws."""
+        if scales is None:
+            return stacked
+        return _tree_corrupt(stacked,
+                             self.mesh.shard_clients(jnp.asarray(scales)))
+
+    def _note_degraded(self, rec, participation: np.ndarray) -> None:
+        """Mark (and warn about) a round whose every client was eliminated
+        by the anomaly gate x dropout x ledger auth — the aggregation
+        programs keep the previous params via their fallback input, so the
+        run continues NaN-free but made no progress this round."""
+        if float(np.asarray(participation).sum()) > 0.0:
+            return
+        rec.degraded = True
+        logger.warning(
+            "round %d: every client eliminated from the aggregate "
+            "(mask/auth all zero) — keeping the previous global model",
+            rec.round)
 
     # ------------------------------------------------------------------- run
 
@@ -412,9 +493,11 @@ class FedEngine:
         trainable = self.trainable0
         stacked = None
 
+        resumed_from_checkpoint = False
         if resume and cfg.checkpoint_dir:
             restored = restore_latest(cfg.checkpoint_dir)
             if restored is not None:
+                resumed_from_checkpoint = True
                 start_round, state, ledger_json = restored
                 start_round += 1
                 ck_name = state.get("prng_impl_name")
@@ -480,6 +563,19 @@ class FedEngine:
 
         rnd = start_round
         while rnd < cfg.num_rounds:
+            if not resumed_from_checkpoint and self.faults.should_crash(rnd):
+                # chaos-plan host crash: nothing of round `rnd` runs; the
+                # newest checkpoint is the only state that survives. Raised
+                # BEFORE any dispatch so a resumed run reproduces the
+                # uninterrupted one bit-for-bit (tests/test_faults.py).
+                # The crash models ONE host failure, so a run that actually
+                # restored a checkpoint does not re-fire it — otherwise the
+                # documented crash -> --resume workflow could never get
+                # past the crash round (resume restarts at or before it).
+                # Gated on the RESTORE, not the resume flag: a standing
+                # --resume on a fresh checkpoint dir must still crash, or
+                # the chaos experiment silently never happens
+                raise SimulatedCrash(rnd)
             chunk = self._chunk_rounds(rnd)
             if chunk > 1:
                 t0 = time.time()
@@ -501,8 +597,8 @@ class FedEngine:
                 rnd += chunk
                 continue
 
-            if (self.fused_tamper is not None
-                    and self.fused_tamper(rnd) is not None):
+            if (self.faults.fused_tamper is not None
+                    and self.faults.fused_tamper(rnd) is not None):
                 # the transport-corruption stage only exists inside the fused
                 # *_fp programs: silently dropping a requested corruption on
                 # a per-round-path round would let a verification test pass
@@ -519,11 +615,22 @@ class FedEngine:
             with clock.phase("control_plane"):
                 gate = self._participation(rnd)
                 mask = gate["mask"].astype(np.float32)
+                # chaos dropout composes with the anomaly gate exactly like
+                # a second filter: the mesh never reshapes, dropped clients
+                # carry weight 0 for the round
+                keep = self.faults.dropout_keep(rnd)
+                dropped = None
+                if keep is not None:
+                    dropped = [c for c in range(cfg.num_clients)
+                               if keep[c] == 0.0]
+                    mask = mask * keep
 
+            delays = self.faults.straggler_delays(rnd)
             with clock.phase("round_program"):
                 if cfg.sync == "async":
                     trainable, stacked, rec = self._async_round(
-                        rnd, trainable, stacked, mask, async_state)
+                        rnd, trainable, stacked, mask, async_state,
+                        delays=delays)
                 elif cfg.mode == "server":
                     trainable, rec = self._server_round(rnd, trainable, mask)
                 elif cfg.faithful:
@@ -534,10 +641,15 @@ class FedEngine:
 
             rec.mask = mask.tolist()
             rec.anomalies = list(gate["anomalies"])
+            if dropped is not None:
+                rec.dropped = dropped
+            if delays is not None:
+                rec.straggler_s = delays.tolist()
             sync_t, async_t = self.graph.info_passing_time(
                 self._payload_gb() if self.ledger is None
                 else self.cfg.ledger.entry_payload_bytes / 1e9,
                 source=self.info_source, anomalies=gate["anomalies"],
+                extra_delay=delays,
             )
             rec.info_passing_sync_s = sync_t
             rec.info_passing_async_s = async_t
@@ -636,7 +748,8 @@ class FedEngine:
                          and self.progs.server_rounds_fp is None)
         if (k <= 1 or cfg.sync != "sync"
                 or (cfg.mode != "server" and cfg.faithful)
-                or ledger_blocks or self.tamper_hook is not None
+                or ledger_blocks or self.faults.host_tamper is not None
+                or self.faults.blocks_fusion()
                 or cfg.topology.anomaly_filter is not None):
             return 1
         k = min(k, cfg.num_rounds - rnd)
@@ -689,9 +802,9 @@ class FedEngine:
         """[k, C] transport-corruption scales for the fused fp programs
         (zeros = clean; see ``fused_tamper`` in ``__init__``)."""
         corr = np.zeros((k, self.cfg.num_clients), np.float32)
-        if self.fused_tamper is not None:
+        if self.faults.fused_tamper is not None:
             for i in range(k):
-                row = self.fused_tamper(rnd + i)
+                row = self.faults.fused_tamper(rnd + i)
                 if row is not None:
                     corr[i] = np.asarray(row, np.float32)
         return self.mesh.shard_round_clients(jnp.asarray(corr))
@@ -807,20 +920,32 @@ class FedEngine:
     def _server_round(self, rnd, trainable, mask):
         batches, n_ex = self._round_batches(rnd)
         rngs = self._rngs(rnd)
-        if self.ledger is None:
+        scales = self.faults.transport_scales(rnd)
+        if self.ledger is None and scales is None:
             w = self._weights(mask, n_ex)
             trainable, stats = self.progs.server_round(
                 trainable, self.frozen, batches, w, rngs)
-            return trainable, self._stats_to_rec(rnd, stats)
-        # ledger flow: commit -> verify -> aggregate; if every update fails
-        # authentication the round keeps its starting params (fallback)
+            rec = self._stats_to_rec(rnd, stats)
+            self._note_degraded(rec, mask)
+            return trainable, rec
+        # split-phase flow: train -> (ledger commit) -> transport ->
+        # (ledger verify) -> aggregate; if every update is eliminated the
+        # round keeps its starting params (collapse fallback). Without the
+        # ledger a corrupted update reaches the aggregation rule — the
+        # robust aggregators (cfg.aggregator) are the defense there.
         stacked, stats = self.progs.client_updates(
             trainable, self.frozen, batches, rngs)
-        auth = self._ledger_verify(rnd, stacked)
-        w = self._weights(mask * auth, n_ex)
-        trainable = self.progs.collapse(stacked, w, trainable)
+        sent = self._transport(stacked, scales)
+        auth = None
+        if self.ledger is not None:
+            auth = self._ledger_verify(rnd, stacked, sent)
+            mask = mask * auth
+        w = self._weights(mask, n_ex)
+        trainable = self.progs.collapse(sent, w, trainable)
         rec = self._stats_to_rec(rnd, stats)
-        rec.auth = auth.tolist()
+        if auth is not None:
+            rec.auth = auth.tolist()
+        self._note_degraded(rec, mask)
         return trainable, rec
 
     def _serverless_round(self, rnd, stacked, prev_consensus, mask):
@@ -828,21 +953,35 @@ class FedEngine:
         rngs = self._rngs(rnd)
         m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
         auth = None
-        if self.ledger is None:
+        scales = self.faults.transport_scales(rnd)
+        if self.ledger is None and scales is None:
             stacked, stats = self.progs.gossip_round(
                 stacked, self.frozen, batches, m, rngs)
         else:
             start = stacked  # pre-train params: what an all-rejected round keeps
             stacked, stats = self.progs.local_updates(
                 stacked, self.frozen, batches, rngs)
-            auth = self._ledger_verify(rnd, stacked)
-            m = self.mesh.shard_clients(jnp.asarray(mask * auth, jnp.float32))
-            stacked = self.progs.mix_only(stacked, m, start)
-        # consensus view for eval/checkpoint (mask-weighted mean)
+            sent = self._transport(stacked, scales)
+            if self.ledger is not None:
+                auth = self._ledger_verify(rnd, stacked, sent)
+                mask = mask * auth
+                m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
+            if sent is not stacked:
+                # corruption poisons only the RECEIVED copies: neighbor and
+                # aggregate terms come from the transported tree, each
+                # sender's own carry stays its honest local state
+                # (__init__ rejects corrupting serverless configs whose impl
+                # has no mix_recv, so this cannot silently fall through to a
+                # mix that rewrites the sender's state with the corruption)
+                stacked = self.progs.mix_recv(stacked, sent, m, start)
+            else:
+                stacked = self.progs.mix_only(stacked, m, start)
+        # consensus view for eval/checkpoint (mask-weighted aggregation)
         consensus = self.progs.collapse(stacked, m, prev_consensus)
         rec = self._stats_to_rec(rnd, stats)
         if auth is not None:
             rec.auth = auth.tolist()
+        self._note_degraded(rec, mask)
         return stacked, consensus, rec
 
     def _faithful_round(self, rnd, trainable, mask):
@@ -859,7 +998,7 @@ class FedEngine:
         keys = client_round_keys(
             jax.random.fold_in(self.root_key, 4), cfg.num_clients, rnd)
         snapshots, host_snaps, snap_fps, all_stats = [], [], [], []
-        fp_mode = self.ledger is not None and self.tamper_hook is None
+        fp_mode = self.ledger is not None and self.faults.host_tamper is None
         # Pin the sequential path to ONE device when the model fits on one.
         # The engine holds trainable replicated over the mesh (the r04
         # steady-state-sharding fix), and jitting the per-client program on
@@ -925,6 +1064,7 @@ class FedEngine:
             w = w * auth
         total = float(w.sum())
         if total <= 0.0:
+            self._note_degraded(rec, w)
             return trainable, rec
         avg = _tree_wsum(jnp.asarray(w / total), snapshots)
         return (jax.device_put(avg, out_sharding) if pin else avg), rec
@@ -964,7 +1104,7 @@ class FedEngine:
             base = float(len(arrived))
         return float(alpha[arrived].sum() / max(base, 1e-9))
 
-    def _async_round(self, rnd, trainable, stacked, mask, st):
+    def _async_round(self, rnd, trainable, stacked, mask, st, delays=None):
         """One buffered-async aggregation event (FedBuff-style): the K
         earliest-finishing clients merge their local DELTAS, each decayed by
         ``staleness_decay ** staleness``; the global takes an
@@ -981,11 +1121,27 @@ class FedEngine:
             stacked, self.frozen, batches, rngs)
         rec = self._stats_to_rec(rnd, stats)
 
+        # chaos stragglers: an affected client's completion slips by the
+        # injected delay, so it arrives later and accumulates staleness —
+        # the fault plan feeding the simulated network clock directly.
+        # ``delays`` is threaded from the run loop's single per-round draw
+        # (None from direct callers, who draw here instead)
+        if delays is None:
+            delays = self.faults.straggler_delays(rnd)
+        if delays is not None:
+            st["next_done"] = st["next_done"] + delays
+            rec.straggler_s = delays.tolist()
+
+        # transport corruption: the transmitted copies (deltas) may be
+        # perturbed; each client's own carried state stays honest
+        sent = self._transport(stacked, self.faults.transport_scales(rnd))
+
         if self.ledger is not None:
-            auth = self._ledger_verify(rnd, stacked)
+            auth = self._ledger_verify(rnd, stacked, sent)
             rec.auth = auth.tolist()
             mask = mask * auth
 
+        self._note_degraded(rec, mask)
         # pick the K earliest arrivals among participating clients
         order = np.argsort(st["next_done"])
         arrived = [c for c in order if mask[c] > 0][:K]
@@ -1000,7 +1156,7 @@ class FedEngine:
             alpha = alpha * n_ex
 
         if arrived:
-            deltas = _tree_sub(stacked, base)
+            deltas = _tree_sub(sent, base)
             zero = jax.tree.map(jnp.zeros_like, trainable)
             # collapse is a weight-NORMALIZED mean (divides by sum(alpha)), so
             # on its own the staleness decay would cancel out of the update

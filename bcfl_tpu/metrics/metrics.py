@@ -75,6 +75,14 @@ class RoundRecord:
     # staleness-decayed merge weight per client for this aggregation event
     # (async mode only)
     async_alpha: Optional[List[float]] = None
+    # True when every client was eliminated from this round's aggregate
+    # (anomaly filter x fault-injected dropout x ledger auth): the engine
+    # kept the previous global model instead of emitting a 0/0 mean
+    degraded: bool = False
+    # fault-injection observability (bcfl_tpu.faults): clients dropped by the
+    # chaos plan this round / per-client injected straggler delay (seconds)
+    dropped: Optional[List[int]] = None
+    straggler_s: Optional[List[float]] = None
     info_passing_sync_s: Optional[float] = None
     info_passing_async_s: Optional[float] = None
     wall_s: float = 0.0

@@ -58,7 +58,12 @@ def build_fed_tp_programs(model, mesh: Mesh, num_clients: Optional[int] = None,
     """Full :class:`~bcfl_tpu.fed.client_step.FedPrograms` set on a
     clients x tp mesh — every 1-D program (server/gossip rounds, fused
     multi-round variants, split-phase ledger flow, eval) at parity.
-    ``kw`` forwards to :func:`~bcfl_tpu.fed.client_step.build_programs`."""
+    ``kw`` forwards to :func:`~bcfl_tpu.fed.client_step.build_programs` —
+    including ``aggregator``/``aggregator_trim``: the Byzantine-robust rules
+    (ROBUSTNESS.md) are the same GSPMD bodies on the 2-D mesh, so a
+    tp-sharded model gets trimmed-mean/median/krum aggregation with no
+    separate implementation (order statistics reduce over the clients axis;
+    XLA keeps the tp sharding inside each client's update)."""
     from bcfl_tpu.fed.client_step import build_programs
 
     return build_programs(model, as_client_mesh(mesh, num_clients),

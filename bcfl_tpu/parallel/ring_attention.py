@@ -121,7 +121,7 @@ def ring_attention_sharded(
 ) -> jnp.ndarray:
     """Convenience wrapper: shard the sequence dim over ``axis_name``, run
     :func:`ring_attention` under ``shard_map``, return the global result."""
-    from jax import shard_map
+    from bcfl_tpu.core.compat import shard_map
 
     qs = P(None, None, axis_name, None)
     bs = P(None, axis_name)

@@ -121,12 +121,19 @@ class LatencyGraph:
         payload_gb: float,
         source: int = 1,
         anomalies: Iterable[int] = (),
+        extra_delay: Optional[Sequence[float]] = None,
     ) -> Tuple[float, float]:
         """(synchronous, asynchronous) information-passing time from ``source``
         to every remaining node, after dropping ``anomalies``.
 
         sync = sum of per-target shortest-path times, async = max (MT nb cell
         23). ``source`` defaults to node 1, the notebooks' worked example.
+
+        ``extra_delay`` ([n] seconds, indexed by ORIGINAL node id) adds a
+        per-target completion delay on top of the transfer time — the
+        fault-injection straggler model (bcfl_tpu.faults): a straggling
+        target receives its information late, stretching sync by its delay
+        and async to the slowest delayed arrival.
         """
         drop = set(int(a) for a in anomalies)
         if source in drop:
@@ -135,6 +142,9 @@ class LatencyGraph:
         times = self.shortest_path_times(payload_gb, keep)
         src = keep.index(source)
         t = np.delete(times[src], src)
+        if extra_delay is not None:
+            d = np.asarray(extra_delay, np.float64)[keep]
+            t = t + np.delete(d, src)
         return float(t.sum()), float(t.max())
 
 

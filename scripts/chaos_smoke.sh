@@ -1,0 +1,16 @@
+#!/bin/bash
+# Chaos smoke: run the fault-injection matrix (tests/test_faults.py) on the
+# virtual 8-device CPU mesh under the tier-1 timeout. The suite asserts the
+# ROBUSTNESS.md contracts: no NaN/Inf under any injected fault class,
+# corrupted updates auth-masked out of the aggregate, crash+resume
+# bit-identical to the uninterrupted run, robust aggregators compiled into
+# the round program without per-round retraces, and truncated-checkpoint
+# fallback. The same tests ride the standard tier-1 command (they are
+# `not slow`); this script is the focused entrypoint for chaos work.
+#
+# Usage: scripts/chaos_smoke.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_faults.py -q -m 'faults and not slow' \
+    -p no:cacheprovider "$@"

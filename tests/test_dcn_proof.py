@@ -7,6 +7,12 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
+# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
+# (tests/test_faults.py) as its fast engine coverage instead
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

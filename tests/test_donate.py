@@ -5,11 +5,27 @@ program's outputs. The engine chains carries, so every mode must produce
 bit-identical metrics to donate=False; the single restriction (run() is
 single-shot) must fail loudly, not corrupt."""
 
+import jax
 import numpy as np
 import pytest
 
 from bcfl_tpu.config import FedConfig, LedgerConfig, PartitionConfig
 from bcfl_tpu.fed.engine import FedEngine
+
+pytestmark = [
+    pytest.mark.slow,  # engine-suite tier: compile-heavy on the 8-device CPU
+    # mesh; the tier-1 'not slow' window runs the chaos matrix
+    # (tests/test_faults.py) as its fast engine coverage instead
+    # jaxlib < 0.5 CPU: donated executables intermittently double-free their
+    # aliased buffers across multi-engine sequences (observed as a flaky
+    # SIGSEGV inside the round dispatch that takes the whole pytest process
+    # down with it). The donation feature itself targets TPU HBM; run this
+    # file on a TPU backend or a newer jaxlib.
+    pytest.mark.skipif(
+        jax.__version__ < "0.5" and jax.default_backend() == "cpu",
+        reason="jaxlib<0.5 CPU backend: flaky double-free of donated "
+               "buffers (process-killing SIGSEGV)"),
+]
 
 
 def _cfg(**kw):

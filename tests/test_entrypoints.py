@@ -49,6 +49,7 @@ def test_smoke_preset_runs():
     assert res.metrics.rounds[-1].global_acc is not None
 
 
+@pytest.mark.slow  # full engine/CLI run: deeper-tier budget
 def test_cli_smoke():
     import os
 
@@ -68,6 +69,7 @@ def test_cli_smoke():
     assert "global_accuracies" in out.stdout
 
 
+@pytest.mark.slow  # full engine/CLI run: deeper-tier budget
 def test_graft_entry_hooks():
     import os
 

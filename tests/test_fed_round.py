@@ -13,6 +13,12 @@ from bcfl_tpu.data import (
 from bcfl_tpu.fed import build_programs
 from bcfl_tpu.models import build, lora as lora_lib
 
+import pytest
+
+pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
+# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
+# (tests/test_faults.py) as its fast engine coverage instead
+
 
 def _setup(num_clients=8, num_labels=2, samples=64, batch=16, seq=32):
     ds = load_dataset("synthetic", num_labels=num_labels, n_train=1024, n_test=256)

@@ -22,6 +22,10 @@ from bcfl_tpu.models.llama import LORA_TARGETS, tp_specs
 from bcfl_tpu.models import lora as lora_lib
 from bcfl_tpu.parallel.fed_tp import build_fed_tp_round, stack_adapters
 
+pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
+# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
+# (tests/test_faults.py) as its fast engine coverage instead
+
 
 def test_distributed_init_single_process_noop():
     assert distributed_init() is False

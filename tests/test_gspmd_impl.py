@@ -22,6 +22,10 @@ from bcfl_tpu.fed.synthetic import synthetic_round_inputs
 from bcfl_tpu.models import build
 from bcfl_tpu.parallel import collectives, gspmd
 
+pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
+# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
+# (tests/test_faults.py) as its fast engine coverage instead
+
 
 def _setup(num_clients, gossip_steps=1, seq=16, batch=4, steps=2):
     model = build("tiny-bert", num_labels=2, vocab_size=512)
@@ -144,7 +148,7 @@ def test_collective_helpers_parity():
     mask = jnp.asarray([1, 1, 0, 1, 1, 1, 0, 1], jnp.float32)
 
     mesh = client_mesh(C)
-    from jax import shard_map
+    from bcfl_tpu.core.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     sm_mean = jax.jit(shard_map(

@@ -12,6 +12,12 @@ from bcfl_tpu.models import build, get_config, list_models, lora_targets
 from bcfl_tpu.models import lora as lora_lib
 from bcfl_tpu.models.llama import LORA_TARGETS, causal_bias, rope, tp_specs
 
+import pytest
+
+pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
+# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
+# (tests/test_faults.py) as its fast engine coverage instead
+
 
 def _init(model, B=2, S=16):
     ids = jnp.ones((B, S), jnp.int32)

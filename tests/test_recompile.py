@@ -16,6 +16,10 @@ import pytest
 from bcfl_tpu.config import FedConfig, PartitionConfig
 from bcfl_tpu.fed.engine import FedEngine
 
+pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
+# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
+# (tests/test_faults.py) as its fast engine coverage instead
+
 
 @pytest.fixture(autouse=True)
 def _fresh_programs(monkeypatch):

@@ -34,6 +34,7 @@ def test_remat_is_numerically_identical(name, kw):
         lambda a, b: float(jnp.abs(a - b).max()), g0, g1))) == 0
 
 
+@pytest.mark.slow  # full engine/CLI run: deeper-tier budget
 def test_remat_engine_round():
     from bcfl_tpu.config import FedConfig, PartitionConfig
     from bcfl_tpu.fed.engine import FedEngine

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+from bcfl_tpu.compression import CompressionConfig
 from bcfl_tpu.faults import FaultPlan
 
 
@@ -225,6 +226,14 @@ class FedConfig:
     # fault-injection schedule (bcfl_tpu.faults, ROBUSTNESS.md); the default
     # plan injects nothing
     faults: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+    # communication compression for the update exchange (COMPRESSION.md):
+    # kind ∈ none/int8/topk/int8+topk — quantized and/or sparsified client
+    # deltas with error-feedback residuals, compiled INTO the round
+    # programs. 'none' (default) is bit-identical to the uncompressed
+    # programs. gspmd impl only; the faithful host-sequential mode has no
+    # transport stage to compress (rejected below).
+    compression: CompressionConfig = dataclasses.field(
+        default_factory=CompressionConfig)
 
     # --- checkpoint / metrics ---
     checkpoint_dir: Optional[str] = None
@@ -293,11 +302,29 @@ class FedConfig:
                 f"aggregator={self.aggregator!r} is not implemented for "
                 "faithful (host-sequential) mode — it always aggregates "
                 "with the reference's plain mean")
+        if self.compression.enabled and self.faithful:
+            # the faithful path host-sequentially mutates ONE shared model;
+            # there is no per-client update exchange, so 'compressing the
+            # wire' would be a label with no wire under it
+            raise ValueError(
+                f"compress={self.compression.kind!r} is not implemented for "
+                "faithful (host-sequential) mode — it exchanges no update "
+                "trees to compress")
         if self.tp > 1 and self.lora_rank <= 0:
             raise ValueError(
                 "tp > 1 tensor-shards the FROZEN base and keeps per-client "
                 "LoRA adapters; set lora_rank > 0 (full fine-tune is 1-D "
                 "clients-only)")
+
+    @property
+    def resolved_prng_impl(self) -> Optional[str]:
+        """jax's registered name for ``prng_impl``: the config (and CLI)
+        accept the colloquial ``"threefry"``, but jax registers the impl as
+        ``"threefry2x32"`` — passing the config value straight to
+        ``jax.random.key(impl=...)`` raised on the documented default's
+        explicit spelling. None passes through (jax's process default)."""
+        return ("threefry2x32" if self.prng_impl == "threefry"
+                else self.prng_impl)
 
     def replace(self, **kw) -> "FedConfig":
         return dataclasses.replace(self, **kw)

@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 
+from bcfl_tpu.compression import KINDS as COMPRESS_KINDS
 from bcfl_tpu.entrypoints.presets import _HF, get_preset, list_presets
 from bcfl_tpu.entrypoints.run import run, run_sweep
 
@@ -114,6 +115,27 @@ def main(argv=None):
     ap.add_argument("--aggregator-trim", type=float, default=None,
                     help="assumed Byzantine fraction for trimmed_mean/krum "
                          "(default 0.2, must be < 0.5)")
+    # communication compression (bcfl_tpu.compression, COMPRESSION.md):
+    # quantized / top-k client deltas with error feedback, compiled into
+    # the round programs; bytes-on-wire lands in the round records
+    ap.add_argument("--compress", default=None,
+                    choices=list(COMPRESS_KINDS),
+                    help="compress the update exchange: int8 = per-chunk "
+                         "quantized deltas (stochastic rounding), topk = "
+                         "top-k sparsified deltas, int8+topk = both; error-"
+                         "feedback residuals keep compression error from "
+                         "accumulating. 'none' is bit-identical to the "
+                         "uncompressed round programs")
+    ap.add_argument("--compress-topk", type=float, default=None,
+                    metavar="FRAC",
+                    help="fraction of coordinates the topk codecs keep "
+                         "(default 0.05)")
+    ap.add_argument("--compress-chunk", type=int, default=None, metavar="N",
+                    help="elements per int8 quantization chunk — one f32 "
+                         "scale each (default 256)")
+    ap.add_argument("--no-compress-ef", action="store_true",
+                    help="disable the error-feedback residual (ablation; "
+                         "compression error then accumulates)")
     # chaos harness (bcfl_tpu.faults.FaultPlan, ROBUSTNESS.md): seeded,
     # deterministic fault injection — the resilience demo knobs
     ap.add_argument("--chaos-dropout", type=float, default=None,
@@ -194,6 +216,26 @@ def main(argv=None):
         overrides["aggregator"] = args.aggregator
     if args.aggregator_trim is not None:
         overrides["aggregator_trim"] = args.aggregator_trim
+    if (args.compress is not None or args.compress_topk is not None
+            or args.compress_chunk is not None or args.no_compress_ef):
+        comp_kw = {"kind": args.compress if args.compress is not None
+                   else cfg.compression.kind}
+        if comp_kw["kind"] == "none" and args.compress != "none":
+            # a codec sub-flag with no codec selected would silently ship
+            # full-precision trees under a compression-tweak label — the
+            # same fail-loudly stance as the shard_map/bench rejections
+            raise SystemExit(
+                "--compress-topk/--compress-chunk/--no-compress-ef have no "
+                "effect without a codec: add --compress "
+                "{int8,topk,int8+topk}")
+        if args.compress_topk is not None:
+            comp_kw["topk_frac"] = args.compress_topk
+        if args.compress_chunk is not None:
+            comp_kw["chunk"] = args.compress_chunk
+        if args.no_compress_ef:
+            comp_kw["error_feedback"] = False
+        overrides["compression"] = dataclasses.replace(
+            cfg.compression, **comp_kw)
     if (args.chaos_dropout is not None or args.chaos_straggler is not None
             or args.chaos_corrupt is not None
             or args.chaos_crash_round is not None):

@@ -20,7 +20,11 @@ into one seeded, deterministic, config-level schedule:
   API behind both legacy hooks (see :class:`FaultInjector`). With the ledger
   on, commit fingerprints are taken before transport and verification after,
   so corrupted clients fail authentication and are excluded; without the
-  ledger, the robust aggregators (``FedConfig.aggregator``) are the defense,
+  ledger, the robust aggregators (``FedConfig.aggregator``) are the defense.
+  When communication compression is on (COMPRESSION.md) the transported
+  quantity is the COMPRESSED payload, and the scales perturb its float
+  parts (quantization scales / top-k values) — the chaos matrix exercises
+  the actual wire format, not a tree the network never carried,
 - **crash** — kill the round loop at a chosen round
   (:class:`SimulatedCrash`); a restart with ``resume=True`` must reproduce
   the uninterrupted run bit-for-bit (tests/test_faults.py pins this).

@@ -32,6 +32,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from bcfl_tpu.compression import CompressionConfig, codecs as cc
 from bcfl_tpu.core.compat import shard_map
 from bcfl_tpu.core.mesh import ClientMesh
 from bcfl_tpu.ledger.fingerprint import client_fingerprint, tree_fingerprint
@@ -222,6 +223,35 @@ class FedPrograms:
     # neighbor/aggregate terms from the TRANSPORTED tree, self-terms from
     # the honest local tree (gspmd impl only)
     mix_recv: Optional[Callable] = None
+    # --- communication-compression programs (COMPRESSION.md; gspmd impl
+    # only, present iff the builder's CompressionConfig is enabled). When
+    # compression is on, the round/fused programs above change signature:
+    # their first argument and first result become the carry tuple
+    # ``(params_tree, ef_residual)`` — the error-feedback residual rides the
+    # round state so compression error never accumulates. Split-phase twins:
+    # (new_t, ref_t, resid, rngs) -> (payload, recon, resid'); ref is the
+    # REPLICATED global (server) or the stacked round-start params
+    # (serverless/async). ``recon`` is the clean-transport reconstruction
+    # (ref + decoded delta) computed inside the encode program — the
+    # roundtrip already decodes to derive the residual, so returning it
+    # saves the engine a redundant full-tree decode on every uncorrupted
+    # ledger round (corrupted rounds re-decode the TRANSPORTED payload via
+    # decode_recon)
+    encode_deltas: Optional[Callable] = None
+    encode_deltas_local: Optional[Callable] = None
+    # async twin WITHOUT the recon output: the async merge decodes the
+    # (possibly corrupted) transported payload itself via decode_delta, so
+    # a returned recon would be computed and thrown away every round
+    encode_deltas_async: Optional[Callable] = None
+    # (payload, ref_t, like_t) -> stacked recon tree (ref + decoded delta,
+    # cast back to the param dtype) — what the receivers aggregate/mix
+    decode_recon: Optional[Callable] = None
+    # (payload, like_t) -> stacked decoded delta (param dtype) — async merge
+    decode_delta: Optional[Callable] = None
+    # (payload, [C] scales) -> transport-corrupted payload (float parts only)
+    corrupt_payload: Optional[Callable] = None
+    # (trainable_like) -> [C, ...] f32 zero error-feedback state
+    ef_init: Optional[Callable] = None
     # fused-round twins that ALSO emit each round's per-client update
     # fingerprints [R, C, K] (gspmd impl only — the ledger can then fuse):
     server_rounds_fp: Optional[Callable] = None
@@ -249,6 +279,13 @@ def build_programs(
     # process default; "rbg" opts into the TPU hardware generator
     # (dropout RNG is +38% of step time under threefry, PERF.md)
     prng_impl: Optional[str] = None,
+    # communication compression for the update exchange (COMPRESSION.md).
+    # A build-time static like the aggregator: every CompressionConfig is
+    # its own compiled program set (the config is part of the program-cache
+    # key below), so switching codecs never retraces inside a run. None or
+    # kind='none' builds EXACTLY today's uncompressed programs — that path
+    # is untouched, bit-for-bit. gspmd impl only.
+    compression: Optional[CompressionConfig] = None,
     # donate=True deletes the caller's input param/opt buffers after each call
     # (halves peak HBM for the round-chained engine); leave False if you reuse
     # the input tree afterwards.
@@ -266,6 +303,13 @@ def build_programs(
 ) -> FedPrograms:
     if impl == "auto":
         impl = os.environ.get("BCFL_FED_IMPL", "gspmd")
+    if compression is not None and not compression.enabled:
+        # normalize so compress='none' and no-compression callers share ONE
+        # cache entry — they are the same programs by construction (the
+        # builders never branch on a disabled config), and the shared entry
+        # makes that identity observable: build_programs(compression=none)
+        # IS build_programs() (tests/test_compression.py pins it)
+        compression = None
     # Program memoization: flax modules and jax Meshes hash/compare by VALUE
     # (module config dataclasses, mesh devices + axis names), so two engines
     # over equal configs get the SAME jitted program objects — and with them
@@ -279,7 +323,7 @@ def build_programs(
         # mesh field, including any added later that changes program layout
         key = (model, mesh, optimizer, learning_rate, max_grad_norm,
                gossip_alpha, gossip_steps, task, aggregator, aggregator_trim,
-               prng_impl, donate, impl)
+               prng_impl, donate, impl, compression)
         hash(key)
     except TypeError:
         key = None
@@ -292,7 +336,7 @@ def build_programs(
         max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
         gossip_steps=gossip_steps, donate=donate, task=task,
         aggregator=aggregator, aggregator_trim=aggregator_trim,
-        prng_impl=prng_impl, impl=impl)
+        prng_impl=prng_impl, compression=compression, impl=impl)
     if key is not None:
         while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
             # FIFO eviction bounds the compiled-executable footprint over a
@@ -325,6 +369,7 @@ def _build_programs_dispatch(
     aggregator: str,
     aggregator_trim: float,
     prng_impl: Optional[str],
+    compression: Optional[CompressionConfig],
     donate: bool,
     impl: str,
 ) -> FedPrograms:
@@ -334,9 +379,21 @@ def _build_programs_dispatch(
             max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
             gossip_steps=gossip_steps, donate=donate, task=task,
             aggregator=aggregator, aggregator_trim=aggregator_trim,
-            prng_impl=prng_impl)
+            prng_impl=prng_impl, compression=compression)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
+    if compression is not None and compression.enabled:
+        # same gap class as the robust aggregators below (both documented in
+        # ROBUSTNESS.md §5): the codecs are global-array math over the full
+        # stacked client dim, and the shard_map twin would need its own
+        # manual-SPMD encode/decode + an error-feedback carry threaded
+        # through every program signature — only the GSPMD programs compile
+        # them today. Failing loudly beats silently shipping full-precision
+        # trees under a compress=... label.
+        raise ValueError(
+            f"compress={compression.kind!r} requires impl='gspmd' (unset "
+            "BCFL_FED_IMPL or set it to 'gspmd'); the shard_map twin has no "
+            "codec path and would silently exchange uncompressed updates")
     if aggregator != "mean":
         # the robust rules are order statistics over the GLOBAL client dim;
         # inside a shard_map body each device sees only its local stack, so
@@ -629,6 +686,7 @@ def _build_programs_gspmd(
     aggregator: str = "mean",
     aggregator_trim: float = 0.2,
     prng_impl: Optional[str] = None,
+    compression: Optional[CompressionConfig] = None,
 ) -> FedPrograms:
     """GSPMD twin of the shard_map builder: identical program signatures and
     semantics (global stacked-client arrays in, global arrays out), but the
@@ -641,7 +699,22 @@ def _build_programs_gspmd(
     view: server FedAvg (per-round and fused), the consensus ``collapse``,
     and the serverless exact-mean (``gossip_steps == 0``). Ring-gossip
     diffusion (``gossip_steps > 0``) keeps its pairwise mixing rule — a
-    two-neighbour exchange has no order statistics to harden."""
+    two-neighbour exchange has no order statistics to harden.
+
+    ``compression`` (enabled) compiles the update-exchange codecs
+    (:mod:`bcfl_tpu.compression`, COMPRESSION.md) into every aggregation
+    path: each client's post-train DELTA vs the round's reference params is
+    error-feedback-compensated, encoded, and only the DECODED (lossy)
+    reconstruction reaches the aggregator / gossip mix — the sender's own
+    carried state stays its honest local tree (the existing ``mix_recv``
+    transport split). The round/fused programs then carry
+    ``(params, ef_residual)`` tuples instead of a bare tree; the fused
+    ``*_fp`` twins fingerprint the COMPRESSED payload before and after the
+    simulated transport stage, so ledger auth covers exactly the bytes on
+    the wire. ``None``/'none' leaves every body below byte-identical to the
+    uncompressed build."""
+    comp = (compression
+            if compression is not None and compression.enabled else None)
     agg = gspmd.make_aggregator(aggregator, aggregator_trim)
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model, task)
@@ -670,8 +743,23 @@ def _build_programs_gspmd(
         avg = agg(new_t, weights, global_t)
         return _c(avg, repl), stats
 
-    server_round = jax.jit(server_body, donate_argnums=_don(0),
-                           out_shardings=(repl, cl))
+    def server_body_comp(carry, frozen, batches, weights, rngs):
+        # compressed FedAvg: the server aggregates each client's
+        # RECONSTRUCTION from the compressed delta — what actually arrived —
+        # never the honest full-precision update
+        global_t, resid = carry
+        new_t, stats = train_clients(global_t, frozen, batches, rngs)
+        payload, dec, resid = _compress_stage(new_t, global_t, resid, rngs)
+        del payload  # clean path: ledger/corruption rounds run split-phase
+        avg = agg(_recon(global_t, dec, new_t), weights, global_t)
+        return (_c(avg, repl), resid), stats
+
+    if comp is None:
+        server_round = jax.jit(server_body, donate_argnums=_don(0),
+                               out_shardings=(repl, cl))
+    else:
+        server_round = jax.jit(server_body_comp, donate_argnums=_don(0),
+                               out_shardings=((repl, cl), cl))
 
     def _transport(new_t, c_row):
         """Simulated transport of a client-stacked update tree: the buffer
@@ -695,6 +783,43 @@ def _build_programs_gspmd(
         auth = jnp.all(fp_recv == fp_commit, axis=-1).astype(jnp.float32)
         return sent_t, fp_commit, fp_recv, _c(auth, cl)
 
+    # ---- communication-compression stages (comp is not None only) ----
+    def _ckey(rngs):
+        # codec stochastic-rounding key: derived from the same per-round
+        # stacked key rows the training consumes, on a lane the training
+        # stream never touches — identical on the per-round and fused paths
+        return cc.codec_key(unstack(rngs))
+
+    def _compress_stage(new_t, ref_t, resid, rngs):
+        """Sender side of one wire exchange: ``(payload, decoded, resid')``
+        for ``delta = new_t - ref_t`` (+ the carried error-feedback
+        residual). ``ref_t`` may be the replicated global (server) or the
+        stacked round-start params (serverless) — the subtract broadcasts."""
+        delta = jax.tree.map(
+            lambda n, g: n.astype(jnp.float32) - g.astype(jnp.float32),
+            new_t, ref_t)
+        payload, dec, resid = cc.roundtrip(comp, delta, resid, _ckey(rngs))
+        return _c(payload, cl), dec, _c(resid, cl)
+
+    def _recon(ref_t, dec, like_t):
+        """Receiver-side reconstruction ``ref + decoded delta``, cast back to
+        the param dtype — the stacked tree the aggregator/mix consumes."""
+        return _c(jax.tree.map(
+            lambda g, d, n: (g.astype(jnp.float32) + d).astype(n.dtype),
+            ref_t, dec, like_t), cl)
+
+    def _fp_auth_payload(payload, c_row):
+        """Compressed twin of ``_fp_auth``: fingerprints are taken over the
+        COMPRESSED payload (the bytes actually on the wire), transport
+        corrupts the payload's float parts, and auth is the in-graph
+        comparison. c_row == 0 keeps the payload bit-identical (exact float
+        identity), so clean rounds authenticate bit-for-bit."""
+        fp_commit = _c(client_fingerprint(payload), cl)
+        sent = cc.corrupt_payload(payload, c_row)
+        fp_recv = _c(client_fingerprint(sent), cl)
+        auth = jnp.all(fp_recv == fp_commit, axis=-1).astype(jnp.float32)
+        return sent, fp_commit, fp_recv, _c(auth, cl)
+
     def _make_server_rounds(static: bool, with_fp: bool):
         """Fused R-round server program; ``with_fp=True`` additionally takes
         a per-round per-client transport-corruption input [R, C] and emits
@@ -713,6 +838,26 @@ def _build_programs_gspmd(
                     (w, r), rest = xs[:2], xs[2:]
                 else:
                     (b, w, r), rest = xs[:3], xs[3:]
+                if comp is not None:
+                    # compressed carry: (global params, EF residual). The
+                    # residual is per-client sender state riding the scan —
+                    # compression error re-enters the next round's encode
+                    # instead of accumulating (COMPRESSION.md).
+                    g, resid = t
+                    new_t, stats = train_clients(g, frozen, b, r)
+                    payload, dec, resid = _compress_stage(new_t, g, resid, r)
+                    if with_fp:
+                        sent, fpc, fpr, auth = _fp_auth_payload(
+                            payload, rest[0])
+                        # decode the TRANSPORTED payload: a corrupted wire
+                        # yields a corrupted reconstruction, which auth
+                        # already excluded from the aggregate
+                        dec = cc.decode_tree(comp, sent, new_t)
+                        avg = _c(agg(_recon(g, dec, new_t), w * auth, g),
+                                 repl)
+                        return (avg, resid), (stats, fpc, fpr, auth)
+                    avg = _c(agg(_recon(g, dec, new_t), w, g), repl)
+                    return (avg, resid), stats
                 new_t, stats = train_clients(t, frozen, b, r)
                 if with_fp:
                     sent_t, fpc, fpr, auth = _fp_auth(new_t, rest[0])
@@ -726,7 +871,9 @@ def _build_programs_gspmd(
                 xs = xs + (corrupts,)
             return lax.scan(one_round, global_t, xs)
 
-        out_sh = (repl, (rcl, rcl, rcl, rcl)) if with_fp else (repl, rcl)
+        carry_sh = repl if comp is None else (repl, cl)
+        out_sh = ((carry_sh, (rcl, rcl, rcl, rcl)) if with_fp
+                  else (carry_sh, rcl))
         return jax.jit(body, donate_argnums=_don(0), out_shardings=out_sh)
 
     server_rounds = _make_server_rounds(static=False, with_fp=False)
@@ -764,8 +911,27 @@ def _build_programs_gspmd(
         new_t, stats = local_updates_body(client_t, frozen, batches, rngs)
         return _c(_mix_g(new_t, mask, client_t), cl), stats
 
-    gossip_round = jax.jit(gossip_body, donate_argnums=_don(0),
-                           out_shardings=(cl, cl))
+    def gossip_body_comp(carry, frozen, batches, mask, rngs):
+        # compressed gossip: the DELTA each peer ships is vs its own
+        # round-start params (which its neighbours hold from the previous
+        # exchange — the standard delta-compression gossip assumption);
+        # neighbour/aggregate terms come from the lossy reconstruction, each
+        # sender's self-term stays its honest post-train tree (mix_recv's
+        # transport split, reused as the codec split)
+        client_t, resid = carry
+        new_t, stats = local_updates_body(client_t, frozen, batches, rngs)
+        payload, dec, resid = _compress_stage(new_t, client_t, resid, rngs)
+        del payload
+        recon = _recon(client_t, dec, new_t)
+        mixed = _c(_mix_g_recv(new_t, recon, mask, client_t), cl)
+        return (mixed, resid), stats
+
+    if comp is None:
+        gossip_round = jax.jit(gossip_body, donate_argnums=_don(0),
+                               out_shardings=(cl, cl))
+    else:
+        gossip_round = jax.jit(gossip_body_comp, donate_argnums=_don(0),
+                               out_shardings=((cl, cl), cl))
 
     def _make_gossip_rounds(static: bool, with_fp: bool):
         """Fused R-round gossip program; ``with_fp`` adds the same
@@ -782,6 +948,22 @@ def _build_programs_gspmd(
                     (m, r), rest = xs[:2], xs[2:]
                 else:
                     (b, m, r), rest = xs[:3], xs[3:]
+                if comp is not None:
+                    # compressed carry (client params, EF residual); see
+                    # gossip_body_comp for the delta-reference semantics
+                    ct, resid = t
+                    new_t, stats = local_updates_body(ct, frozen, b, r)
+                    payload, dec, resid = _compress_stage(new_t, ct, resid, r)
+                    if with_fp:
+                        sent, fpc, fpr, auth = _fp_auth_payload(
+                            payload, rest[0])
+                        dec = cc.decode_tree(comp, sent, new_t)
+                        mixed = _c(_mix_g_recv(
+                            new_t, _recon(ct, dec, new_t), m * auth, ct), cl)
+                        return (mixed, resid), (stats, fpc, fpr, auth)
+                    mixed = _c(_mix_g_recv(
+                        new_t, _recon(ct, dec, new_t), m, ct), cl)
+                    return (mixed, resid), stats
                 new_t, stats = local_updates_body(t, frozen, b, r)
                 if with_fp:
                     sent_t, fpc, fpr, auth = _fp_auth(new_t, rest[0])
@@ -795,7 +977,9 @@ def _build_programs_gspmd(
                 xs = xs + (corrupts,)
             return lax.scan(one_round, client_t, xs)
 
-        out_sh = (cl, (rcl, rcl, rcl, rcl)) if with_fp else (cl, rcl)
+        carry_sh = cl if comp is None else (cl, cl)
+        out_sh = ((carry_sh, (rcl, rcl, rcl, rcl)) if with_fp
+                  else (carry_sh, rcl))
         return jax.jit(body, donate_argnums=_don(0), out_shardings=out_sh)
 
     gossip_rounds = _make_gossip_rounds(static=False, with_fp=False)
@@ -837,6 +1021,43 @@ def _build_programs_gspmd(
         lambda t, w, fallback: _c(agg(t, w, fallback), repl),
         out_shardings=repl)
 
+    # ---- split-phase codec programs (per-round ledger/corruption flow) ----
+    # The engine composes these exactly like the uncompressed split-phase
+    # sequence (client_updates -> commit -> transport -> verify ->
+    # aggregate), except the quantity that is fingerprinted, corrupted, and
+    # shipped is the compressed payload. Same codec math as the in-graph
+    # stages above, so fused and per-round rounds commit identical digests
+    # for identical content.
+    encode_deltas = encode_deltas_local = decode_recon = decode_delta = None
+    encode_deltas_async = corrupt_payload_p = ef_init = None
+    if comp is not None:
+        def _enc(new_t, ref_t, resid, rngs):
+            payload, dec, resid = _compress_stage(new_t, ref_t, resid, rngs)
+            return payload, _recon(ref_t, dec, new_t), resid
+
+        def _enc_delta(new_t, ref_t, resid, rngs):
+            payload, _, resid = _compress_stage(new_t, ref_t, resid, rngs)
+            return payload, resid
+
+        # separate jit objects so the replicated-ref (server/global) and
+        # stacked-ref (serverless) traces each own one cache entry
+        encode_deltas = jax.jit(_enc)
+        encode_deltas_local = jax.jit(_enc)
+        encode_deltas_async = jax.jit(_enc_delta)
+        decode_recon = jax.jit(
+            lambda payload, ref_t, like_t: _recon(
+                ref_t, cc.decode_tree(comp, payload, like_t), like_t))
+        decode_delta = jax.jit(
+            lambda payload, like_t: _c(jax.tree.map(
+                lambda d, n: d.astype(n.dtype),
+                cc.decode_tree(comp, payload, like_t), like_t), cl))
+        corrupt_payload_p = jax.jit(
+            lambda payload, scales: _c(cc.corrupt_payload(payload, scales),
+                                       cl))
+        ef_init = jax.jit(
+            lambda t: cc.zero_residual(t, mesh.num_clients),
+            out_shardings=cl)
+
     return FedPrograms(
         mesh=mesh,
         server_round=server_round,
@@ -862,4 +1083,11 @@ def _build_programs_gspmd(
         gossip_rounds_fp=gossip_rounds_fp,
         gossip_rounds_static_fp=gossip_rounds_static_fp,
         mix_recv=mix_recv,
+        encode_deltas=encode_deltas,
+        encode_deltas_local=encode_deltas_local,
+        encode_deltas_async=encode_deltas_async,
+        decode_recon=decode_recon,
+        decode_delta=decode_delta,
+        corrupt_payload=corrupt_payload_p,
+        ef_init=ef_init,
     )

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bcfl_tpu.checkpoint import restore_latest, save_checkpoint
+from bcfl_tpu.compression import codecs as cc
 from bcfl_tpu.config import FedConfig
 from bcfl_tpu.core import client_mesh, client_round_keys, pod_devices
 from bcfl_tpu.core.fence import fence
@@ -133,7 +134,8 @@ class FedEngine:
         self.faults = FaultInjector(
             cfg.faults, cfg.num_clients,
             host_tamper=tamper_hook, fused_tamper=fused_tamper)
-        self.root_key = jax.random.key(cfg.seed, impl=cfg.prng_impl)
+        self.root_key = jax.random.key(cfg.seed,
+                                       impl=cfg.resolved_prng_impl)
         # RESOLVED key impl: with prng_impl=None the run follows jax's
         # process default, which env vars can change — checkpoints must
         # record what actually ran, not the config field. The NAME is the
@@ -258,9 +260,26 @@ class FedEngine:
             task=cfg.task,
             aggregator=cfg.aggregator,
             aggregator_trim=cfg.aggregator_trim,
-            prng_impl=cfg.prng_impl,
+            prng_impl=cfg.resolved_prng_impl,
+            compression=cfg.compression,
             donate=cfg.donate,
         )
+        # communication compression (COMPRESSION.md): None when disabled.
+        # The error-feedback residual (stacked [C, ...] f32) is engine round
+        # state, lazily initialized in _run and checkpointed — crash/resume
+        # must reproduce compressed runs bit-for-bit too.
+        self._comp = cfg.compression if cfg.compression.enabled else None
+        self._ef = None
+        if self._comp is not None and tamper_hook is not None:
+            # the legacy host-tamper shim byte-hashes FULL host trees; with
+            # compression the wire carries payloads, so the two transport
+            # models cannot compose (same exclusivity as FaultPlan corruption
+            # vs tamper_hook)
+            raise ValueError(
+                "tamper_hook models byte-tampering of full host update "
+                "trees; with compression enabled the wire carries encoded "
+                "payloads — schedule corruption via FedConfig.faults "
+                "(it corrupts the compressed representation)")
         if (self.faults.plan.corrupts and cfg.mode == "serverless"
                 and cfg.sync != "async" and self.progs.mix_recv is None):
             # async is exempt: _async_round never mixes — `sent` feeds only
@@ -302,12 +321,31 @@ class FedEngine:
         self.info_source = info_source % cfg.num_clients
 
         self.ledger = Ledger(cfg.ledger.use_native) if cfg.ledger.enabled else None
-        # fingerprint-mode ledger state: per-client payload accounting and
-        # lazily-computed structure digests (no device transfer involved)
-        self._client_payload_bytes = int(sum(
-            np.prod(np.asarray(x.shape)) * x.dtype.itemsize
-            for x in jax.tree.leaves(self.trainable0)))
+        # bytes-on-wire accounting (COMPRESSION.md): what ONE client ships
+        # per round, raw vs through the configured codec — host-side shape
+        # arithmetic, no device transfer. Equal when compression is off.
+        # Feeds RoundRecord.bytes_*, the topology comms model (_payload_gb),
+        # and the ledger's per-entry payload accounting: the chain covers
+        # (and bills for) what is actually transmitted.
+        self._raw_bytes_per_client = cc.payload_nbytes(None, self.trainable0)
+        self._wire_bytes_per_client = cc.payload_nbytes(
+            self._comp, self.trainable0)
+        self._client_payload_bytes = int(self._wire_bytes_per_client)
         self._struct_cache: Dict[str, bytes] = {}
+        if self._comp is not None and self.ledger is not None:
+            # ledger entries digest the COMPRESSED payload: precompute its
+            # structure digest from an eval_shape of the encoder (no device
+            # work), so split-phase and fused rounds bind identical digests
+            C = cfg.num_clients
+
+            def _payload_shape(t):
+                stacked = jax.tree.map(
+                    lambda x: jnp.zeros((C,) + x.shape, jnp.float32), t)
+                return cc.encode_tree(self._comp, stacked, jax.random.key(0))
+
+            self._struct_cache["payload"] = fp_lib.struct_digest(
+                jax.eval_shape(_payload_shape, self.trainable0),
+                cfg.ledger.use_native)
         self.eval_batches = jax.tree.map(
             jnp.asarray, central_eval_batches(self.cache, cfg.batch_size,
                                               max_batches=cfg.max_eval_batches))
@@ -350,7 +388,27 @@ class FedEngine:
         )
 
     def _payload_gb(self) -> float:
-        return model_size_gb(self.trainable0)
+        # the comms model scales by what actually crosses a link: the codec
+        # payload when compression is on, the raw tree otherwise (for
+        # compress=none this equals model_size_gb(trainable0) exactly —
+        # both are sum(size * itemsize) / 1e9)
+        return self._wire_bytes_per_client / 1e9
+
+    def _comms_payload_bytes(self) -> int:
+        """What one update exchange ships, for the info-passing model.
+
+        Compression wins over the ledger constant: with a codec on, the
+        update payload on the wire IS the compressed encoding (and the
+        ledger's own accounting already bills those same bytes per entry —
+        using the reference's fixed 0.043 GB blockchain figure here would
+        make the two accountings disagree). Uncompressed ledger runs keep
+        the reference's modeled ledger-entry payload (MT nb cell 27);
+        everything else ships the raw tree."""
+        if self._comp is not None:
+            return int(self._wire_bytes_per_client)
+        if self.ledger is not None:
+            return int(self.cfg.ledger.entry_payload_bytes)
+        return int(self._raw_bytes_per_client)
 
     def _global_eval(self, trainable) -> tuple:
         s = np.asarray(self.progs.eval_global(trainable, self.frozen, self.eval_batches))
@@ -376,6 +434,12 @@ class FedEngine:
         same content."""
         struct = self._struct_cache.get(kind)
         if struct is None:
+            if kind == "payload":
+                # precomputed in __init__ whenever ledger + compression are
+                # both on; reaching here means a payload digest was requested
+                # on an uncompressed run — a caller bug, not a cache miss
+                raise RuntimeError(
+                    "payload struct digest requested without compression")
             tmpl = self.trainable0
             if kind == "stacked":
                 C = self.cfg.num_clients
@@ -406,16 +470,21 @@ class FedEngine:
             else 0.0
             for c in range(self.cfg.num_clients)], np.float32)
 
-    def _ledger_verify(self, rnd: int, stacked, sent=None) -> np.ndarray:
+    def _ledger_verify(self, rnd: int, stacked, sent=None,
+                       kind: str = "stacked") -> np.ndarray:
         """Commit every client's update, then authenticate what arrived.
         Returns the 0/1 auth mask.
 
-        ``stacked`` is the honest post-train tree each client COMMITS;
-        ``sent`` (default: the same buffer) is the tree that survived the
-        simulated transport stage and is about to be aggregated. When the
-        fault plan corrupts transport the two differ, and authentication
-        genuinely fails for exactly the corrupted clients — the per-round
-        twin of the fused ``*_fp`` programs' in-graph commit/verify split.
+        ``stacked`` is the honest tree each client COMMITS; ``sent``
+        (default: the same buffer) is the tree that survived the simulated
+        transport stage and is about to be aggregated. When the fault plan
+        corrupts transport the two differ, and authentication genuinely
+        fails for exactly the corrupted clients — the per-round twin of the
+        fused ``*_fp`` programs' in-graph commit/verify split.
+
+        With compression on, callers pass the COMPRESSED payload trees and
+        ``kind='payload'``: the chain then authenticates exactly the bytes
+        on the wire, not a tree the network never carried.
 
         Default path: the content digest is a device-side fingerprint
         (:mod:`bcfl_tpu.ledger.fingerprint`) — only ``[C, K]`` floats cross
@@ -439,17 +508,49 @@ class FedEngine:
                                        jax.tree.map(lambda x: x[c], host))
                 return self._ledger_authenticate(rnd, host)
             fp = np.asarray(self.progs.fingerprint(stacked))
-            self._ledger_commit_rows(rnd, "stacked", fp)
+            self._ledger_commit_rows(rnd, kind, fp)
             if sent is None or sent is stacked:
                 # the committed HBM buffer IS the aggregated one: re-running
                 # the fingerprint program would reproduce `fp` bit-for-bit
                 # (device arrays are immutable), so auth re-derives digests
                 # from it directly
-                return self._ledger_auth_rows(rnd, "stacked", fp)
+                return self._ledger_auth_rows(rnd, kind, fp)
             fp_recv = np.asarray(self.progs.fingerprint(sent))
-            return self._ledger_auth_rows(rnd, "stacked", fp_recv)
+            return self._ledger_auth_rows(rnd, kind, fp_recv)
 
     # ------------------------------------------------------- fault utilities
+
+    def _compressed_exchange(self, rnd, new_t, ref_t, rngs, scales, mode):
+        """One compressed wire exchange on the per-round split-phase path,
+        shared by the server/serverless/async round bodies so the corruption
+        sharding, transported-payload decode, and ledger verify kind can
+        never drift apart (the fused programs apply the same sequence
+        in-graph). ``mode`` picks the encoder: "global" (delta vs the
+        replicated global), "local" (vs the stacked round-start params), or
+        "async" (recon-free — the async merge decodes deltas itself).
+        Returns ``(sent_payload, recon_or_None, auth_or_None)``."""
+        if mode == "async":
+            payload, self._ef = self.progs.encode_deltas_async(
+                new_t, ref_t, self._ef, rngs)
+            recon = None
+        else:
+            enc = (self.progs.encode_deltas if mode == "global"
+                   else self.progs.encode_deltas_local)
+            payload, recon, self._ef = enc(new_t, ref_t, self._ef, rngs)
+        if scales is None:
+            sent_p = payload
+        else:
+            sent_p = self.progs.corrupt_payload(
+                payload, self.mesh.shard_clients(jnp.asarray(scales)))
+            if recon is not None:
+                # a corrupted wire yields a corrupted reconstruction —
+                # re-decode the TRANSPORTED payload (the clean-path recon
+                # came fused with the encode)
+                recon = self.progs.decode_recon(sent_p, ref_t, new_t)
+        auth = None
+        if self.ledger is not None:
+            auth = self._ledger_verify(rnd, payload, sent_p, kind="payload")
+        return sent_p, recon, auth
 
     def _transport(self, stacked, scales):
         """Simulated transport of the round's stacked updates: returns the
@@ -519,6 +620,20 @@ class FedEngine:
                         f"run's {self._prng_code} "
                         f"(prng_impl={cfg.prng_impl!r}): resuming would "
                         "change the RNG stream")
+                ck_comp = state.get("compress_format")
+                if ck_comp is not None:
+                    ck_comp = bytes(np.asarray(ck_comp, np.uint8)).decode()
+                    here = cc.wire_format(self._comp)
+                    if ck_comp != here:
+                        # a codec change across resume would re-inject the
+                        # checkpointed error-feedback residual into a
+                        # different encode (or drop it) silently — same
+                        # guard class as the prng-impl check above
+                        raise ValueError(
+                            f"checkpoint was written with compress="
+                            f"{ck_comp!r} but this run has {here!r}: "
+                            "resuming would change the wire format under "
+                            "the carried error-feedback state")
                 ck_seed = state.get("seed")
                 if ck_seed is not None and int(ck_seed) != cfg.seed:
                     raise ValueError(
@@ -537,6 +652,14 @@ class FedEngine:
 
                 if state.get("stacked") is not None:
                     stacked = self.mesh.shard_clients(_cast(state["stacked"]))
+                if (state.get("ef_residual") is not None
+                        and self._comp is not None):
+                    # error-feedback state travels with the checkpoint: a
+                    # compressed crash/resume must re-inject exactly the
+                    # residual the uninterrupted run would have carried
+                    self._ef = self.mesh.shard_clients(jax.tree.map(
+                        lambda x: jnp.asarray(x, jnp.float32),
+                        state["ef_residual"]))
                 # replicate: a resumed tree left on the default device would
                 # re-trigger the round-2 recompile (tests/test_recompile.py)
                 trainable = self.mesh.replicate(_cast(state["trainable"]))
@@ -555,6 +678,11 @@ class FedEngine:
                 "donated the initial trainable buffers to the round "
                 "program. Build a fresh FedEngine (or resume from a "
                 "checkpoint, or set donate=False) to run again.")
+
+        if self._comp is not None and self._ef is None:
+            # fresh error-feedback state (zeros): round 1's encode sees the
+            # pure delta, later rounds re-inject what compression dropped
+            self._ef = self.progs.ef_init(trainable)
 
         if cfg.mode == "serverless" and not cfg.faithful and stacked is None:
             stacked = self.progs.broadcast(trainable)
@@ -646,10 +774,9 @@ class FedEngine:
             if delays is not None:
                 rec.straggler_s = delays.tolist()
             sync_t, async_t = self.graph.info_passing_time(
-                self._payload_gb() if self.ledger is None
-                else self.cfg.ledger.entry_payload_bytes / 1e9,
-                source=self.info_source, anomalies=gate["anomalies"],
+                0.0, source=self.info_source, anomalies=gate["anomalies"],
                 extra_delay=delays,
+                payload_bytes=self._comms_payload_bytes(),
             )
             rec.info_passing_sync_s = sync_t
             rec.info_passing_async_s = async_t
@@ -666,6 +793,18 @@ class FedEngine:
         metrics.model_size_gb = model_size_gb(params)
         metrics.resources = monitor.snapshot()
         metrics.phases = clock.summary()
+        # run-level bytes-on-wire accounting (COMPRESSION.md): per-round
+        # totals are on every RoundRecord; this is the headline rollup
+        C = cfg.num_clients
+        metrics.comms = {
+            "compress": cfg.compression.kind,
+            "bytes_raw_per_round": float(self._raw_bytes_per_client * C),
+            "bytes_on_wire_per_round": float(
+                self._wire_bytes_per_client * C),
+            "compression_ratio": float(
+                self._raw_bytes_per_client
+                / max(self._wire_bytes_per_client, 1)),
+        }
         if self.ledger is not None and len(self.ledger):
             metrics.ledger = self.ledger.payload_accounting()
             metrics.ledger["chain_ok"] = float(self.ledger.verify_chain() == -1)
@@ -707,6 +846,14 @@ class FedEngine:
         state = {
             "trainable": jax.device_get(trainable),
             "stacked": jax.device_get(stacked) if stacked is not None else None,
+            # compression error-feedback residual (None when compression is
+            # off); required for bit-identical compressed crash/resume
+            "ef_residual": (jax.device_get(self._ef)
+                            if self._ef is not None else None),
+            # codec identity, uint8-encoded (orbax trees hold arrays):
+            # resume refuses a wire-format change under the carried residual
+            "compress_format": np.frombuffer(
+                cc.wire_format(self._comp).encode(), np.uint8).copy(),
             # the RNG stream is derived deterministically from the seed +
             # round + key impl; storing both lets resume verify them
             "seed": np.int64(cfg.seed),
@@ -791,12 +938,15 @@ class FedEngine:
         the in-graph aggregation already excluded exactly those clients."""
         fps_commit = np.asarray(fps_commit)  # blocks on the fused dispatch
         fps_recv = np.asarray(fps_recv)
+        # compressed fused rounds fingerprint the PAYLOAD (client_step
+        # _fp_auth_payload), so the chain entry binds the payload structure
+        kind = "stacked" if self._comp is None else "payload"
         with self.clock.phase("ledger"):
             for i in range(k):
-                self._ledger_commit_rows(rnd + i, "stacked", fps_commit[i])
+                self._ledger_commit_rows(rnd + i, kind, fps_commit[i])
             for i, rec in enumerate(recs):
                 rec.auth = self._ledger_auth_rows(
-                    rnd + i, "stacked", fps_recv[i]).tolist()
+                    rnd + i, kind, fps_recv[i]).tolist()
 
     def _chunk_corrupts(self, rnd: int, k: int):
         """[k, C] transport-corruption scales for the fused fp programs
@@ -817,23 +967,28 @@ class FedEngine:
             np.full((cfg.num_clients,),
                     n_ex if cfg.weighted_agg else 1.0, np.float32)
             for n_ex in n_ex_list])))
+        # compressed programs carry (params, error-feedback residual)
+        carry = trainable if self._comp is None else (trainable, self._ef)
         if self.ledger is not None:
             prog = (self.progs.server_rounds_static_fp if static
                     else self.progs.server_rounds_fp)
-            trainable, (stats, fpc, fpr, _auth) = prog(
-                trainable, self.frozen, batches, rweights, rrngs,
+            carry, (stats, fpc, fpr, _auth) = prog(
+                carry, self.frozen, batches, rweights, rrngs,
                 self._chunk_corrupts(rnd, k))
+            if self._comp is not None:
+                carry, self._ef = carry
             stats = np.asarray(stats)
             recs = [self._stats_to_rec(rnd + i, stats[i]) for i in range(k)]
             self._commit_chunk_fps(rnd, k, fpc, fpr, recs)
-            return trainable, recs
+            return carry, recs
         prog = (self.progs.server_rounds_static if static
                 else self.progs.server_rounds)
-        trainable, stats = prog(trainable, self.frozen, batches, rweights,
-                                rrngs)
+        carry, stats = prog(carry, self.frozen, batches, rweights, rrngs)
+        if self._comp is not None:
+            carry, self._ef = carry
         stats = np.asarray(stats)  # [k, C, 3]
-        return trainable, [self._stats_to_rec(rnd + i, stats[i])
-                           for i in range(k)]
+        return carry, [self._stats_to_rec(rnd + i, stats[i])
+                       for i in range(k)]
 
     def _serverless_chunk(self, rnd, stacked, prev_consensus, k):
         """Run gossip rounds [rnd, rnd+k) in ONE dispatch via gossip_rounds.
@@ -848,17 +1003,22 @@ class FedEngine:
         masks = self.mesh.shard_round_clients(
             jnp.ones((k, cfg.num_clients), jnp.float32))
         fps = None
+        carry = stacked if self._comp is None else (stacked, self._ef)
         if self.ledger is not None:
             prog = (self.progs.gossip_rounds_static_fp if static
                     else self.progs.gossip_rounds_fp)
-            stacked, (stats, fpc, fpr, _auth) = prog(
-                stacked, self.frozen, batches, masks, rrngs,
+            carry, (stats, fpc, fpr, _auth) = prog(
+                carry, self.frozen, batches, masks, rrngs,
                 self._chunk_corrupts(rnd, k))
             fps = (fpc, fpr)
         else:
             prog = (self.progs.gossip_rounds_static if static
                     else self.progs.gossip_rounds)
-            stacked, stats = prog(stacked, self.frozen, batches, masks, rrngs)
+            carry, stats = prog(carry, self.frozen, batches, masks, rrngs)
+        if self._comp is None:
+            stacked = carry
+        else:
+            stacked, self._ef = carry
         # collapse (a full-tree consensus all-reduce + host round-trip) only
         # when this chunk's end is observable — an eval round, a checkpoint
         # round, or the end of the run; otherwise the value would be
@@ -888,9 +1048,8 @@ class FedEngine:
         chunk-derived so consumers can tell interpolated from measured."""
         C = self.cfg.num_clients
         sync_t, async_t = self.graph.info_passing_time(
-            self._payload_gb() if self.ledger is None
-            else self.cfg.ledger.entry_payload_bytes / 1e9,
-            source=self.info_source, anomalies=())
+            0.0, source=self.info_source, anomalies=(),
+            payload_bytes=self._comms_payload_bytes())
         for rec in recs:
             rec.mask = [1.0] * C
             rec.anomalies = []
@@ -906,11 +1065,20 @@ class FedEngine:
         s = np.asarray(stats)  # [C, 3]
         n = np.maximum(s[:, 2], 1)
         total = s.sum(0)
+        C = self.cfg.num_clients
+        raw = float(self._raw_bytes_per_client * C)
+        wire = float(self._wire_bytes_per_client * C)
         return RoundRecord(
             round=rnd,
             train_loss=float(total[0] / max(total[2], 1)),
             train_acc=float(total[1] / max(total[2], 1)),
             local_acc=(s[:, 1] / n).tolist(),
+            # bytes-on-wire accounting: one shipped update per client per
+            # round, raw vs through the configured codec (equal at
+            # compress=none)
+            bytes_raw=raw,
+            bytes_on_wire=wire,
+            compression_ratio=raw / max(wire, 1.0),
         )
 
     def _weights(self, mask: np.ndarray, n_ex: np.ndarray) -> jnp.ndarray:
@@ -923,8 +1091,13 @@ class FedEngine:
         scales = self.faults.transport_scales(rnd)
         if self.ledger is None and scales is None:
             w = self._weights(mask, n_ex)
-            trainable, stats = self.progs.server_round(
-                trainable, self.frozen, batches, w, rngs)
+            if self._comp is None:
+                trainable, stats = self.progs.server_round(
+                    trainable, self.frozen, batches, w, rngs)
+            else:
+                # compressed carry: (params, error-feedback residual)
+                (trainable, self._ef), stats = self.progs.server_round(
+                    (trainable, self._ef), self.frozen, batches, w, rngs)
             rec = self._stats_to_rec(rnd, stats)
             self._note_degraded(rec, mask)
             return trainable, rec
@@ -935,13 +1108,25 @@ class FedEngine:
         # robust aggregators (cfg.aggregator) are the defense there.
         stacked, stats = self.progs.client_updates(
             trainable, self.frozen, batches, rngs)
-        sent = self._transport(stacked, scales)
         auth = None
-        if self.ledger is not None:
-            auth = self._ledger_verify(rnd, stacked, sent)
-            mask = mask * auth
-        w = self._weights(mask, n_ex)
-        trainable = self.progs.collapse(sent, w, trainable)
+        if self._comp is None:
+            sent = self._transport(stacked, scales)
+            if self.ledger is not None:
+                auth = self._ledger_verify(rnd, stacked, sent)
+                mask = mask * auth
+            w = self._weights(mask, n_ex)
+            trainable = self.progs.collapse(sent, w, trainable)
+        else:
+            # the wire quantity is the compressed payload: the ledger
+            # commits/authenticates ITS fingerprints, transport corruption
+            # perturbs IT, and the server aggregates each client's
+            # reconstruction from what arrived
+            _, recon, auth = self._compressed_exchange(
+                rnd, stacked, trainable, rngs, scales, mode="global")
+            if auth is not None:
+                mask = mask * auth
+            w = self._weights(mask, n_ex)
+            trainable = self.progs.collapse(recon, w, trainable)
         rec = self._stats_to_rec(rnd, stats)
         if auth is not None:
             rec.auth = auth.tolist()
@@ -955,8 +1140,27 @@ class FedEngine:
         auth = None
         scales = self.faults.transport_scales(rnd)
         if self.ledger is None and scales is None:
-            stacked, stats = self.progs.gossip_round(
-                stacked, self.frozen, batches, m, rngs)
+            if self._comp is None:
+                stacked, stats = self.progs.gossip_round(
+                    stacked, self.frozen, batches, m, rngs)
+            else:
+                (stacked, self._ef), stats = self.progs.gossip_round(
+                    (stacked, self._ef), self.frozen, batches, m, rngs)
+        elif self._comp is not None:
+            # compressed split-phase: peers ship encoded deltas vs their own
+            # round-start params; the ledger chains payload fingerprints,
+            # transport corruption perturbs the payload, and the mix consumes
+            # each peer's RECONSTRUCTION while the sender's self-term stays
+            # its honest post-train tree (mix_recv)
+            start = stacked
+            stacked, stats = self.progs.local_updates(
+                stacked, self.frozen, batches, rngs)
+            _, recon, auth = self._compressed_exchange(
+                rnd, stacked, start, rngs, scales, mode="local")
+            if auth is not None:
+                mask = mask * auth
+                m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
+            stacked = self.progs.mix_recv(stacked, recon, m, start)
         else:
             start = stacked  # pre-train params: what an all-rejected round keeps
             stacked, stats = self.progs.local_updates(
@@ -1133,11 +1337,27 @@ class FedEngine:
             rec.straggler_s = delays.tolist()
 
         # transport corruption: the transmitted copies (deltas) may be
-        # perturbed; each client's own carried state stays honest
-        sent = self._transport(stacked, self.faults.transport_scales(rnd))
-
-        if self.ledger is not None:
-            auth = self._ledger_verify(rnd, stacked, sent)
+        # perturbed; each client's own carried state stays honest. With
+        # compression the transmitted quantity IS the encoded delta payload
+        # (async is delta-exchange by construction, so the codec slots in
+        # exactly where _tree_sub used to run). EF semantics under partial
+        # arrival: the residual advances for EVERY client each round, but a
+        # non-arrived client's base is its OWN carried post-train state, so
+        # its next delta stays incremental — the kept mass of an unmerged
+        # payload is dropped exactly like the uncompressed path drops
+        # unmerged deltas, and the residual re-delivers only compression
+        # error (no update mass is ever applied twice).
+        scales = self.faults.transport_scales(rnd)
+        auth = None
+        if self._comp is None:
+            sent = self._transport(stacked, scales)
+            sent_p = None
+            if self.ledger is not None:
+                auth = self._ledger_verify(rnd, stacked, sent)
+        else:
+            sent_p, _, auth = self._compressed_exchange(
+                rnd, stacked, base, rngs, scales, mode="async")
+        if auth is not None:
             rec.auth = auth.tolist()
             mask = mask * auth
 
@@ -1156,7 +1376,8 @@ class FedEngine:
             alpha = alpha * n_ex
 
         if arrived:
-            deltas = _tree_sub(sent, base)
+            deltas = (_tree_sub(sent, base) if self._comp is None
+                      else self.progs.decode_delta(sent_p, stacked))
             zero = jax.tree.map(jnp.zeros_like, trainable)
             # collapse is a weight-NORMALIZED mean (divides by sum(alpha)), so
             # on its own the staleness decay would cancel out of the update

@@ -26,7 +26,12 @@ the params and no per-element PRNG):
 Any single element change moves the fingerprint (its lane picks up a
 nonzero ``delta * w_m`` contribution that the dense projection spreads over
 all K outputs); the position weights make value *moves* within a lane
-detectable too. Deterministic across calls and processes. This is a
+detectable too. The construction is dtype-generic (every leaf is cast to
+f32 before folding), which is what lets the engine fingerprint COMPRESSED
+payload trees — int8 codes, f32 scales/values, int32 indices — so chain
+auth covers the bytes actually on the wire (COMPRESSION.md §3; int32
+indices above 2^24 can alias in the f32 cast, a cooperative-audit caveat of
+the same class as the note below). Deterministic across calls and processes. This is a
 *content* fingerprint for tamper-evidence in a cooperative audit chain, not
 a cryptographic MAC over the raw bytes: an adversary who knows the
 construction could craft a colliding tree, so faithful byte-hashing

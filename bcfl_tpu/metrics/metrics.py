@@ -85,6 +85,12 @@ class RoundRecord:
     straggler_s: Optional[List[float]] = None
     info_passing_sync_s: Optional[float] = None
     info_passing_async_s: Optional[float] = None
+    # bytes-on-wire accounting (COMPRESSION.md): what this round's update
+    # exchange shipped across all clients — raw full-precision size vs the
+    # configured codec's payload (equal, ratio 1.0, at compress=none)
+    bytes_raw: Optional[float] = None
+    bytes_on_wire: Optional[float] = None
+    compression_ratio: Optional[float] = None
     wall_s: float = 0.0
     # True when this round ran inside a fused multi-round dispatch: wall_s
     # is then the chunk total split EVENLY across its rounds (an
@@ -102,6 +108,9 @@ class RunMetrics:
     ledger: Dict[str, float] = dataclasses.field(default_factory=dict)
     # per-phase step timings from metrics.tracing.StepClock
     phases: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
+    # communication accounting rollup: codec kind, per-round raw vs
+    # bytes-on-wire, and the compression ratio (COMPRESSION.md)
+    comms: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def global_accuracies(self) -> List[float]:
@@ -116,6 +125,7 @@ class RunMetrics:
             "resources": self.resources,
             "ledger": self.ledger,
             "phases": self.phases,
+            "comms": self.comms,
             "global_accuracies": self.global_accuracies,
         }, indent=2)
 

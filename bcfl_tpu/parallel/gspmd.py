@@ -229,7 +229,11 @@ def gossip_mix_recv(self_tree: Tree, recv_tree: Tree, mask: jnp.ndarray,
     self-term comes from ``self_tree`` (its local, honest state) while the
     neighbor terms are ring-shifted from ``recv_tree`` (the transported
     copies, which a corrupted link may have perturbed — the fused-ledger
-    verification path). With ``recv_tree`` value-equal to ``self_tree``
+    verification path). The communication codecs ride the same split
+    (COMPRESSION.md): ``recv_tree`` is then each peer's lossy
+    reconstruction from the compressed delta payload, so only what crossed
+    the wire diffuses — a sender's own carry never degrades through its own
+    codec. With ``recv_tree`` value-equal to ``self_tree``
     this is bit-identical to ``gossip_mix``. Only the FIRST step models
     transport (later steps exchange post-mix state, whose transport is not
     simulated)."""

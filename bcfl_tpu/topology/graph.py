@@ -122,6 +122,7 @@ class LatencyGraph:
         source: int = 1,
         anomalies: Iterable[int] = (),
         extra_delay: Optional[Sequence[float]] = None,
+        payload_bytes: Optional[int] = None,
     ) -> Tuple[float, float]:
         """(synchronous, asynchronous) information-passing time from ``source``
         to every remaining node, after dropping ``anomalies``.
@@ -134,7 +135,15 @@ class LatencyGraph:
         fault-injection straggler model (bcfl_tpu.faults): a straggling
         target receives its information late, stretching sync by its delay
         and async to the slowest delayed arrival.
+
+        ``payload_bytes`` overrides ``payload_gb`` with an exact byte count —
+        the comms model scales linearly in payload size, and the
+        communication-compression accounting (COMPRESSION.md) supplies the
+        actual bytes-on-wire of the codec payload rather than a rounded GB
+        figure.
         """
+        if payload_bytes is not None:
+            payload_gb = payload_bytes / 1e9
         drop = set(int(a) for a in anomalies)
         if source in drop:
             raise ValueError(f"source node {source} is in the anomaly set")

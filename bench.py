@@ -26,6 +26,10 @@ BCFL_BENCH_PLATFORM=<platform> redirects the backend via jax.config (the
 JAX_PLATFORMS env var is overridden by site hooks on some hosts);
 BCFL_BENCH_MODE=serverless times the fused gossip program (gossip_rounds —
 per-client params held in HBM across the block) instead of server FedAvg.
+BCFL_BENCH_COMPRESS={none,int8,topk,int8+topk} compiles the update-exchange
+codec (COMPRESSION.md) into the timed round program and adds bytes-on-wire
+fields to the JSON line — the throughput-per-codec axis of the
+scripts/tpu_perf.py --compress sweep.
 """
 
 from __future__ import annotations
@@ -45,6 +49,12 @@ ROUNDS = int(os.environ.get("BCFL_BENCH_ROUNDS", "32"))  # fed rounds / dispatch
 STEPS = int(os.environ.get("BCFL_BENCH_STEPS", "8"))  # local batches / round
 ITERS = int(os.environ.get("BCFL_BENCH_ITERS", "2"))  # timed dispatches
 MODE = os.environ.get("BCFL_BENCH_MODE", "server")  # server | serverless
+# update-exchange codec compiled into the timed program (COMPRESSION.md).
+# COMPRESS_KINDS must match bcfl_tpu.compression.KINDS — kept literal here
+# because nothing may import the package (and with it jax) before the
+# backend-init watchdog is armed; tests/test_compression.py pins the copies
+COMPRESS_KINDS = ("none", "int8", "topk", "int8+topk")
+COMPRESS = os.environ.get("BCFL_BENCH_COMPRESS", "none")
 STAGE_TIMEOUT_S = 1200.0  # per STAGE, reset on every stage transition
 # backend init gets a SHORT deadline: healthy init is 20-40s, a wedged
 # tunnel hangs forever, and the error JSON must outrun the DRIVER's own
@@ -67,6 +77,15 @@ def _emit(obj):
 def _metric_name():
     tag = "serverless_" if MODE == "serverless" else ""
     return f"bert-base_fed_{tag}finetune_samples_per_sec_per_chip"
+
+
+def _compress_cfg():
+    """CompressionConfig for BCFL_BENCH_COMPRESS, or None at 'none'."""
+    if COMPRESS == "none":
+        return None
+    from bcfl_tpu.compression import CompressionConfig
+
+    return CompressionConfig(kind=COMPRESS)
 
 
 def _error_json(stage: str, err: str):
@@ -159,6 +178,12 @@ def main():
         _error_json("config", f"unknown BCFL_BENCH_MODE {MODE!r}; "
                     "expected 'server' or 'serverless'")
         sys.exit(1)
+    if COMPRESS not in COMPRESS_KINDS:
+        # same fail-fast class: a typo'd codec would silently time the
+        # uncompressed program under a compression label
+        _error_json("config", f"unknown BCFL_BENCH_COMPRESS {COMPRESS!r}; "
+                    "expected none/int8/topk/int8+topk")
+        sys.exit(1)
     watchdog.stage("backend-init", INIT_TIMEOUT_S)
 
     try:
@@ -212,7 +237,8 @@ def main():
         # artifact, results/dispatch_bisect.json)
         params = jax.device_put(params, mesh.replicated())
         n_params = sum(x.size for x in jax.tree.leaves(params))
-        progs = build_programs(model, mesh, donate=True)
+        comp = _compress_cfg()
+        progs = build_programs(model, mesh, donate=True, compression=comp)
 
         batches, weights, rngs = synthetic_round_inputs(
             mesh, steps=STEPS, batch=BATCH, seq=SEQ, vocab_size=30_000)
@@ -241,6 +267,14 @@ def main():
             carry = params
             run_block = lambda c: progs.server_rounds(  # noqa: E731
                 c, None, rbatches, rweights, rrngs)[0]
+
+        if comp is not None:
+            # compressed round programs carry (params, EF residual); the
+            # run_block's [0] then chains the whole tuple
+            watchdog.stage("ef-init")
+            ef = progs.ef_init(params)
+            fence(ef)
+            carry = (carry, ef)
 
         # timed-region fence: same host-readback idea as core.fence, but
         # through ONE pre-compiled program (a single tunnel RTT, negligible
@@ -297,6 +331,18 @@ def main():
         }
         if prng:
             out["prng"] = prng
+        if comp is not None or "BCFL_BENCH_COMPRESS" in os.environ:
+            # bytes-on-wire axis (COMPRESSION.md): one shipped update per
+            # client per round, raw vs through the codec (an explicit
+            # compress=none run still records its raw baseline row)
+            from bcfl_tpu.compression import payload_nbytes
+
+            raw_b = payload_nbytes(None, params) * num_clients
+            wire_b = payload_nbytes(comp, params) * num_clients
+            out["compress"] = COMPRESS
+            out["bytes_raw_per_round"] = int(raw_b)
+            out["bytes_on_wire_per_round"] = int(wire_b)
+            out["compression_ratio"] = round(raw_b / max(wire_b, 1), 2)
         if peak:
             out["mfu_pct"] = round(100.0 * flops / dt / (peak * n_dev), 2)
         # a rate above peak silicon is not a measurement, it is a broken

@@ -199,6 +199,52 @@ def bench_sweep(trace_dir=None, quick=False, plat=None):
     return rows
 
 
+def _compression_rows_path(plat):
+    return os.path.join(REPO_ROOT, "results",
+                        f"compression_rows_{plat}.json")
+
+
+# must match bcfl_tpu.compression.KINDS — kept literal because this module
+# arms its backend-init watchdog BEFORE any jax-importing package import;
+# tests/test_compression.py pins the copies in sync
+COMPRESS_CODECS = ("none", "int8", "topk", "int8+topk")
+
+
+def compression_sweep(codecs, quick=False, plat=None):
+    """Headline bench per update-exchange codec (COMPRESSION.md): one fixed
+    modest dispatch shape, swept over BCFL_BENCH_COMPRESS — emits throughput
+    AND bytes-on-wire per codec, the 'communication-efficient' evidence the
+    title claims. Same subprocess/row-merge discipline as bench_sweep."""
+    rounds, steps = (1, 4) if quick else (4, 8)
+    rows = []
+    for codec in codecs:
+        env = dict(os.environ,
+                   BCFL_BENCH_ROUNDS=str(rounds), BCFL_BENCH_STEPS=str(steps),
+                   BCFL_BENCH_ITERS="2", BCFL_BENCH_COMPRESS=codec,
+                   BCFL_BENCH_RETRIES="0")
+        env.pop("BCFL_BENCH_TRACE", None)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                              "bench.py")],
+                env=env, capture_output=True, text=True, timeout=5400)
+            line = [l for l in out.stdout.splitlines() if l.startswith("{")]
+            row = (json.loads(line[-1]) if line
+                   else {"error": out.stderr[-300:]})
+        except subprocess.TimeoutExpired:
+            row = {"error": "bench subprocess exceeded 5400s"}
+        row["compress"] = codec  # present even on error rows (merge key)
+        row["rounds"], row["steps"] = rounds, steps
+        rows.append(row)
+        print(f"bench compress={codec}: {row}", flush=True)
+    rows = _merge_rows(rows, _compression_rows_path(plat), key="compress")
+    if plat and rows and not any("error" in r for r in rows):
+        with open(_compression_rows_path(plat), "w") as f:
+            json.dump({"source": "tpu_perf compression_sweep (recorded live)",
+                       "rows": rows}, f, indent=1)
+    return rows
+
+
 def attention_sweep(quick=False, plat=None):
     """Pallas fwd/bwd vs XLA blockwise vs dense, by sequence length."""
     import jax
@@ -386,7 +432,7 @@ def _prev_table_rows(section, header_needle):
 
 
 def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir,
-                  path=None):
+                  comp_rows=None, path=None):
     prev_section = _prev_auto_section(path or
                                       os.path.join(REPO_ROOT, "PERF.md"))
     lines = [
@@ -429,6 +475,39 @@ def write_perf_md(device: str, bench_rows, attn_shape, attn_rows, trace_dir,
         lines.append(
             f"| {r['rounds']} | {r['steps']} | {r['value']} | "
             f"{r['vs_baseline']} | {r.get('mfu_pct', '—')} |")
+    lines += [
+        "",
+        "## Communication compression (`--compress` sweep)",
+        "",
+        "Update-exchange codecs compiled into the timed round program "
+        "(COMPRESSION.md): throughput per codec plus bytes-on-wire per "
+        "round — the measured form of the title's 'communication-"
+        "efficient'. Reproduce: `python scripts/tpu_perf.py --compress all`.",
+        "",
+        "| compress | samples/s/chip | bytes-on-wire/round | raw/round | "
+        "ratio |",
+        "|---|---|---|---|---|",
+    ]
+    if not comp_rows:
+        # no sweep this run: keep recorded rows, else an explicit placeholder
+        lines += (_prev_table_rows(prev_section, "| compress |")
+                  or ["| (no rows recorded yet — run `scripts/tpu_perf.py "
+                      "--compress all` on the TPU host) | | | | |"])
+    for r in comp_rows or []:
+        if "error" in r:
+            err = str(r["error"]).replace("\n", " ").replace("|", "\\|")
+            lines.append(f"| {r.get('compress', '—')} | ERROR: {err} | | | |")
+            continue
+
+        def _mb(v):
+            return (f"{v / 1e6:.1f} MB" if isinstance(v, (int, float))
+                    else "—")
+
+        lines.append(
+            f"| {r.get('compress', 'none')} | {r['value']} | "
+            f"{_mb(r.get('bytes_on_wire_per_round'))} | "
+            f"{_mb(r.get('bytes_raw_per_round'))} | "
+            f"{r.get('compression_ratio', 1.0)} |")
     failed_note = None
     prev_attn_rows = _prev_table_rows(prev_section, "| seq | pallas fwd ms |")
     if not attn_rows and isinstance(attn_shape, str) \
@@ -516,6 +595,11 @@ def main(argv=None):
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--skip-bench", action="store_true")
     ap.add_argument("--skip-ledger-auth", action="store_true")
+    ap.add_argument("--compress", default=None, metavar="CODECS",
+                    help="comma-separated update-exchange codecs to bench "
+                         "(subset of none,int8,topk,int8+topk) or 'all'; "
+                         "omitted = reuse previously recorded rows for the "
+                         "PERF.md compression table")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -561,6 +645,19 @@ def main(argv=None):
         bench_rows = _merge_rows(
             bench_sweep(args.trace_dir, args.quick, plat=plat),
             _bench_rows_path(plat), key=("rounds", "steps"))
+    comp_rows = []
+    if args.compress:
+        codecs = (list(COMPRESS_CODECS) if args.compress == "all"
+                  else [c.strip() for c in args.compress.split(",")])
+        bad = [c for c in codecs if c not in COMPRESS_CODECS]
+        if bad:
+            raise SystemExit(f"--compress: unknown codecs {bad}; "
+                             f"pick from {COMPRESS_CODECS} or 'all'")
+        comp_rows = compression_sweep(codecs, args.quick, plat=plat)
+    elif os.path.exists(_compression_rows_path(plat)):
+        # reuse recorded codec rows (same contract as --skip-bench's table)
+        with open(_compression_rows_path(plat)) as f:
+            comp_rows = json.load(f)["rows"]
     # an attention failure must not discard the completed bench evidence
     try:
         attn_shape, attn_rows = attention_sweep(args.quick, plat=plat)
@@ -599,7 +696,7 @@ def main(argv=None):
               flush=True)
         attn_rows = []
     write_perf_md(device, bench_rows, attn_shape, attn_rows, args.trace_dir,
-                  path=out_path)
+                  comp_rows=comp_rows, path=out_path)
     print(f"wrote {out_path or 'PERF.md'}", flush=True)
     # Exit semantics for the unattended loop (PERF.md is already written —
     # the code only governs the stage's done marker): wedges never reach

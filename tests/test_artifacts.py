@@ -44,3 +44,14 @@ def test_recorded_bench_lines():
     for rel in ("results/bench_r04_green.json",):
         d = _load(rel)
         assert d["unit"] == "samples/sec/chip" and d["value"] > 0
+
+
+def test_comm_overhead_record():
+    """COMPRESSION.md acceptance artifact: >= 4x bytes-on-wire reduction at
+    int8+topk AND uncompressed-final-loss reached within tolerance."""
+    d = _load("results/comm_overhead.json")
+    assert d["pass_ge_4x_reduction"] and d["pass_loss_within_tol"]
+    assert d["int8_topk_reduction_x"] >= 4.0
+    assert d["rows"]["none"]["compression_ratio"] == 1.0
+    assert (d["rows"]["int8+topk"]["bytes_on_wire_per_round"]
+            < d["rows"]["none"]["bytes_on_wire_per_round"])

@@ -11,12 +11,22 @@ import pytest
 from bcfl_tpu.models import build
 
 
-@pytest.mark.parametrize("name,kw", [
-    ("tiny-bert", {}),
-    ("tiny-albert", {}),  # share_layers path wraps the shared layer once
-    ("tiny-llama", {}),
+@pytest.mark.parametrize("name,kw,grad_tol", [
+    ("tiny-bert", {}, 0.0),
+    ("tiny-albert", {}, 0.0),  # share_layers path wraps the shared layer once
+    ("tiny-llama", {}, 1e-6),
 ])
-def test_remat_is_numerically_identical(name, kw):
+def test_remat_is_numerically_identical(name, kw, grad_tol):
+    """Forward logits must be BIT-identical for every family (remat replays
+    the same forward graph). Gradients are bit-identical for the encoders,
+    but tiny-llama's differ from the non-remat build by ~7e-8 max-abs
+    (float32): remat recomputes the RMSNorm/SiLU forward INSIDE the backward
+    pass, and XLA fuses that recomputation with the surrounding backward ops
+    differently from the stored-activation graph — the rsqrt/mean
+    contractions re-associate by ~1 ulp. Same math, different float
+    summation order; ``grad_tol=1e-6`` absolute bounds it (observed 6.6e-8)
+    so a real remat semantics bug (wrong policy, dropped term — errors of
+    1e-2-scale) still fails loudly."""
     m0 = build(name, num_labels=2, **kw)
     m1 = build(name, num_labels=2, remat=True, **kw)
     ids = jnp.ones((2, 16), jnp.int32)
@@ -31,7 +41,7 @@ def test_remat_is_numerically_identical(name, kw):
     g0 = jax.grad(loss(m0))(params)
     g1 = jax.grad(loss(m1))(params)
     assert max(jax.tree.leaves(jax.tree.map(
-        lambda a, b: float(jnp.abs(a - b).max()), g0, g1))) == 0
+        lambda a, b: float(jnp.abs(a - b).max()), g0, g1))) <= grad_tol
 
 
 @pytest.mark.slow  # full engine/CLI run: deeper-tier budget

@@ -122,6 +122,29 @@ def test_failed_sweep_with_no_prior_rows_does_not_claim_preservation(
     assert "previously recorded rows kept" not in text
 
 
+def test_compression_rows_render_and_placeholder(tmp_path):
+    """The --compress sweep table: fresh rows render with MB formatting +
+    ratio; with nothing recorded the explicit placeholder appears (never a
+    silently absent section — the axis must be visible even before the
+    first TPU window runs it)."""
+    path = _write_fixture(tmp_path)
+    tp.write_perf_md(
+        "TPU v5 lite", [], "B=2, H=12, D=64", [], None,
+        comp_rows=[{"compress": "int8+topk", "value": 900.0,
+                    "bytes_on_wire_per_round": 27e6,
+                    "bytes_raw_per_round": 438e6,
+                    "compression_ratio": 16.2},
+                   {"compress": "topk", "error": "wedge"}],
+        path=path)
+    text = open(path).read()
+    assert "| int8+topk | 900.0 | 27.0 MB | 438.0 MB | 16.2 |" in text
+    assert "| topk | ERROR: wedge |" in text
+    assert BENCH_ROW in text  # other tables still preserved
+    p2 = str(tmp_path / "P2.md")
+    tp.write_perf_md("TPU v5 lite", [], "B=1", [], None, path=p2)
+    assert "no rows recorded yet" in open(p2).read()
+
+
 def test_fresh_rows_replace_tables_and_drop_failure_note(tmp_path):
     path = _write_fixture(tmp_path)
     tp.write_perf_md(

@@ -4,7 +4,14 @@ The reference only saves (``global_model.save_pretrained(...)`` every round,
 ``serverless_NonIID_IMDB.py:305`` — doubling as its model-size probe) and has
 no load/resume path at all (SURVEY.md §5). Here a checkpoint is
 ``(round, param state, ledger json, rng seed)`` and :func:`restore_latest`
-actually resumes a run mid-training.
+actually resumes a run mid-training. The state tree is deliberately open:
+the engine also threads the compression error-feedback residual
+(COMPRESSION.md) and the peer-lifecycle reputation arrays
+(``rep_trust``/``rep_state``/``rep_timer`` + counters, ROBUSTNESS.md §6)
+through it, so a resumed run re-enters with every trust score and
+quarantine timer exactly where the crash left them — the bit-identical
+crash/resume contract covers the lifecycle trajectory, not just the
+params.
 
 Crash safety (ROBUSTNESS.md):
 

@@ -15,6 +15,7 @@ from typing import Optional, Tuple
 
 from bcfl_tpu.compression import CompressionConfig
 from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.reputation import ReputationConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,7 +168,13 @@ class FedConfig:
 
     # --- federated topology ---
     mode: str = "server"  # "server" (centralized FedAvg) | "serverless" (P2P gossip)
-    sync: str = "sync"  # "sync" | "async" (host-scheduled, staleness-weighted)
+    # "sync" | "async". Async is SIMULATED asynchrony under a deterministic
+    # network clock: one buffered (FedBuff-style) aggregation event per
+    # engine round, arrival order from the latency graph + chaos straggler
+    # delays, staleness decay on merged deltas. It is NOT wall-clock
+    # concurrency — see PARALLELISM.md "Async semantics" for the exact
+    # contract (what the simulated clock does and does not model).
+    sync: str = "sync"
     num_clients: int = 4
     num_rounds: int = 2
     local_epochs: int = 1  # reference: 1 epoch per round (server_IID_IMDB.py:172)
@@ -226,6 +233,14 @@ class FedConfig:
     # fault-injection schedule (bcfl_tpu.faults, ROBUSTNESS.md); the default
     # plan injects nothing
     faults: FaultPlan = dataclasses.field(default_factory=FaultPlan)
+    # peer-lifecycle reputation (bcfl_tpu.reputation, ROBUSTNESS.md §6):
+    # EWMA trust over per-round evidence (ledger-auth failures, anomaly
+    # flags, corruption hits, staleness) drives HEALTHY -> SUSPECT ->
+    # QUARANTINED -> PROBATION -> HEALTHY; quarantined peers are excluded
+    # from aggregation for a configurable window and readmitted at reduced
+    # vote weight. Host-side state, checkpointed; disabled by default.
+    reputation: ReputationConfig = dataclasses.field(
+        default_factory=ReputationConfig)
     # communication compression for the update exchange (COMPRESSION.md):
     # kind ∈ none/int8/topk/int8+topk — quantized and/or sparsified client
     # deltas with error-feedback residuals, compiled INTO the round
@@ -291,9 +306,34 @@ class FedConfig:
                 f"{self.aggregator_trim}")
         if self.faults.corrupts and self.faithful:
             raise ValueError(
-                "FaultPlan corruption models transport of the parallel "
-                "paths' stacked updates; faithful (host-sequential) mode "
-                "has no transport stage — use the tamper_hook shim there")
+                "FaultPlan corruption (incl. flaky bursts) models transport "
+                "of the parallel paths' stacked updates; faithful "
+                "(host-sequential) mode has no transport stage — use the "
+                "tamper_hook shim there")
+        if self.faults.partitions:
+            # the partition lane routes partitioned rounds through the
+            # stacked split-phase flow with per-component aggregation
+            # (ROBUSTNESS.md §6); paths with no per-component form are
+            # rejected here rather than silently aggregating across a
+            # partition that is supposed to exist
+            if self.sync == "async":
+                raise ValueError(
+                    "chaos partition is not implemented for sync='async': "
+                    "the buffered FedBuff merge has one global arrival "
+                    "queue, and per-component queues would be a different "
+                    "algorithm, not a fault model")
+            if self.faithful:
+                raise ValueError(
+                    "chaos partition is not implemented for faithful "
+                    "(host-sequential) mode — clients share ONE model, so "
+                    "there is nothing to partition")
+            if self.mode == "serverless" and self.topology.gossip_steps > 0:
+                raise ValueError(
+                    "chaos partition with ring-gossip diffusion "
+                    "(gossip_steps > 0) would need a per-component ring — "
+                    "a mesh reshape the fault model forbids; use "
+                    "gossip_steps=0 (exact mean) for partitioned "
+                    "serverless runs")
         if self.aggregator != "mean" and self.faithful:
             # the faithful path averages snapshots host-side with a plain
             # weighted sum; silently running that under a robust-aggregator

@@ -94,6 +94,12 @@ def main(argv=None):
     ap.add_argument("--anomaly-filter",
                     choices=["pagerank", "dbscan", "zscore", "community", "none"],
                     default=None)
+    ap.add_argument("--gossip-steps", type=int, default=None,
+                    help="ring-gossip diffusion steps per serverless round "
+                         "(0 = exact mask-weighted mean via the configured "
+                         "--aggregator — required for --chaos-partition in "
+                         "serverless mode; ring diffusion has no "
+                         "per-component form)")
     ap.add_argument("--fused-tamper", action="append", default=None,
                     metavar="ROUND:CLIENT:SCALE",
                     help="inject a simulated transport corruption (additive "
@@ -154,8 +160,69 @@ def main(argv=None):
     ap.add_argument("--chaos-crash-round", type=int, default=None,
                     metavar="N", help="inject a host crash at round N "
                     "(resume afterwards with --resume)")
+    # partition / churn / flaky lanes (ROBUSTNESS.md §6)
+    ap.add_argument("--chaos-partition", default=None, metavar="GROUPS",
+                    help="split the mesh into isolated components for the "
+                         "--chaos-partition-rounds span: explicit groups "
+                         "like '0,1/2,3' (slash-separated; unlisted clients "
+                         "form one extra component) or an integer N for a "
+                         "seeded N-way split. Each component aggregates "
+                         "independently with the configured --aggregator "
+                         "and the components reconcile through the same "
+                         "rule on heal")
+    ap.add_argument("--chaos-partition-rounds", default=None,
+                    metavar="START:END",
+                    help="half-open round span the partition lasts, e.g. "
+                         "'2:5' = rounds 2,3,4 (required with "
+                         "--chaos-partition)")
+    ap.add_argument("--chaos-churn-leave", action="append", default=None,
+                    metavar="CLIENT:ROUND",
+                    help="client CLIENT permanently leaves at round ROUND "
+                         "(repeatable; the mesh never reshapes — the client "
+                         "carries weight 0 from then on)")
+    ap.add_argument("--chaos-churn-join", action="append", default=None,
+                    metavar="CLIENT:ROUND",
+                    help="client CLIENT joins late at round ROUND "
+                         "(repeatable; absent — weight 0 — before it)")
+    ap.add_argument("--chaos-flaky", default=None, metavar="CLIENTS",
+                    help="comma-separated client ids that corrupt transport "
+                         "in intermittent multi-round bursts — the "
+                         "repeat-offender input reputation quarantine "
+                         "exists for (see --reputation)")
+    ap.add_argument("--chaos-flaky-burst", type=int, default=None,
+                    metavar="N", help="rounds per flaky burst window "
+                    "(default 3)")
+    ap.add_argument("--chaos-flaky-on-prob", type=float, default=None,
+                    metavar="P", help="probability each flaky window "
+                    "actually bursts (default 0.5)")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="seed of the chaos schedule (independent of --seed)")
+    # peer-lifecycle reputation (bcfl_tpu.reputation, ROBUSTNESS.md §6)
+    ap.add_argument("--reputation", action="store_true",
+                    help="enable the peer-lifecycle state machine: EWMA "
+                         "trust over per-round evidence (ledger-auth "
+                         "failures, anomaly flags, corruption hits, "
+                         "staleness) drives HEALTHY -> SUSPECT -> "
+                         "QUARANTINED -> PROBATION; quarantined peers are "
+                         "excluded for --reputation-quarantine-rounds and "
+                         "readmitted at --reputation-probation-weight")
+    ap.add_argument("--reputation-alpha", type=float, default=None,
+                    metavar="A", help="EWMA trust update rate (default 0.4)")
+    ap.add_argument("--reputation-suspect-below", type=float, default=None,
+                    metavar="T", help="trust below T -> SUSPECT "
+                    "(default 0.7)")
+    ap.add_argument("--reputation-quarantine-below", type=float,
+                    default=None, metavar="T",
+                    help="trust below T -> QUARANTINED (default 0.4)")
+    ap.add_argument("--reputation-quarantine-rounds", type=int, default=None,
+                    metavar="N", help="rounds a quarantined peer sits out "
+                    "(default 3)")
+    ap.add_argument("--reputation-probation-rounds", type=int, default=None,
+                    metavar="N", help="clean probation rounds before full "
+                    "readmission (default 2)")
+    ap.add_argument("--reputation-probation-weight", type=float,
+                    default=None, metavar="W",
+                    help="vote weight while on probation (default 0.5)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=None)
     ap.add_argument("--platform", default=None,
@@ -205,9 +272,14 @@ def main(argv=None):
         overrides["donate"] = True
     if args.faithful:
         overrides["faithful"] = True
-    if args.anomaly_filter is not None:
-        f = None if args.anomaly_filter == "none" else args.anomaly_filter
-        overrides["topology"] = dataclasses.replace(cfg.topology, anomaly_filter=f)
+    if args.anomaly_filter is not None or args.gossip_steps is not None:
+        topo_kw = {}
+        if args.anomaly_filter is not None:
+            topo_kw["anomaly_filter"] = (None if args.anomaly_filter == "none"
+                                         else args.anomaly_filter)
+        if args.gossip_steps is not None:
+            topo_kw["gossip_steps"] = args.gossip_steps
+        overrides["topology"] = dataclasses.replace(cfg.topology, **topo_kw)
     if args.ledger:
         overrides["ledger"] = dataclasses.replace(cfg.ledger, enabled=True)
     if args.pod:
@@ -236,19 +308,103 @@ def main(argv=None):
             comp_kw["error_feedback"] = False
         overrides["compression"] = dataclasses.replace(
             cfg.compression, **comp_kw)
-    if (args.chaos_dropout is not None or args.chaos_straggler is not None
-            or args.chaos_corrupt is not None
-            or args.chaos_crash_round is not None):
+    def _pair_schedule(entries, flag):
+        if not entries:
+            return None
+        out = []
+        for s in entries:
+            try:
+                c, r = s.split(":")
+                out.append((int(c), int(r)))
+            except ValueError:
+                raise SystemExit(f"{flag} {s!r}: expected CLIENT:ROUND")
+        return tuple(out)
+
+    chaos_flags = (
+        args.chaos_dropout is not None or args.chaos_straggler is not None
+        or args.chaos_corrupt is not None
+        or args.chaos_crash_round is not None
+        or args.chaos_partition is not None
+        or args.chaos_churn_leave or args.chaos_churn_join
+        or args.chaos_flaky is not None)
+    if chaos_flags:
         from bcfl_tpu.faults import FaultPlan
 
-        overrides["faults"] = FaultPlan(
+        plan_kw = dict(
             seed=args.chaos_seed,
             dropout_prob=args.chaos_dropout or 0.0,
             straggler_prob=args.chaos_straggler or 0.0,
             straggler_delay_s=args.chaos_straggler_delay,
             corrupt_prob=args.chaos_corrupt or 0.0,
             crash_at_round=args.chaos_crash_round,
+            churn_leave=_pair_schedule(args.chaos_churn_leave,
+                                       "--chaos-churn-leave"),
+            churn_join=_pair_schedule(args.chaos_churn_join,
+                                      "--chaos-churn-join"),
         )
+        if args.chaos_partition is not None:
+            if args.chaos_partition_rounds is None:
+                raise SystemExit("--chaos-partition needs "
+                                 "--chaos-partition-rounds START:END")
+            try:
+                lo, hi = (int(x) for x in
+                          args.chaos_partition_rounds.split(":"))
+            except ValueError:
+                raise SystemExit(
+                    f"--chaos-partition-rounds "
+                    f"{args.chaos_partition_rounds!r}: expected START:END")
+            if hi <= lo:
+                # an empty span would make the partition silently never
+                # fire (FaultPlan rejects it too; fail in CLI style here)
+                raise SystemExit(
+                    f"--chaos-partition-rounds "
+                    f"{args.chaos_partition_rounds!r}: empty span "
+                    "(END must be > START; the span is half-open)")
+            plan_kw["partition_rounds"] = tuple(range(lo, hi))
+            spec = args.chaos_partition
+            if "/" in spec or "," in spec:
+                try:
+                    plan_kw["partition_groups"] = tuple(
+                        tuple(int(c) for c in g.split(","))
+                        for g in spec.split("/") if g)
+                except ValueError:
+                    raise SystemExit(f"--chaos-partition {spec!r}: expected "
+                                     "groups like 0,1/2,3 or an integer N")
+            else:
+                try:
+                    plan_kw["partition_count"] = int(spec)
+                except ValueError:
+                    raise SystemExit(f"--chaos-partition {spec!r}: expected "
+                                     "groups like 0,1/2,3 or an integer N")
+        if args.chaos_flaky is not None:
+            try:
+                plan_kw["flaky_clients"] = tuple(
+                    int(c) for c in args.chaos_flaky.split(","))
+            except ValueError:
+                raise SystemExit(f"--chaos-flaky {args.chaos_flaky!r}: "
+                                 "expected comma-separated client ids")
+            if args.chaos_flaky_burst is not None:
+                plan_kw["flaky_burst_len"] = args.chaos_flaky_burst
+            if args.chaos_flaky_on_prob is not None:
+                plan_kw["flaky_on_prob"] = args.chaos_flaky_on_prob
+        overrides["faults"] = FaultPlan(**plan_kw)
+    rep_tweaks = {
+        "ewma_alpha": args.reputation_alpha,
+        "suspect_below": args.reputation_suspect_below,
+        "quarantine_below": args.reputation_quarantine_below,
+        "quarantine_rounds": args.reputation_quarantine_rounds,
+        "probation_rounds": args.reputation_probation_rounds,
+        "probation_weight": args.reputation_probation_weight,
+    }
+    rep_tweaks = {k: v for k, v in rep_tweaks.items() if v is not None}
+    if rep_tweaks and not args.reputation:
+        # same fail-loudly stance as the codec sub-flags: a tuning flag
+        # with the subsystem off would silently change nothing
+        raise SystemExit("--reputation-* tuning flags have no effect "
+                         "without --reputation")
+    if args.reputation:
+        overrides["reputation"] = dataclasses.replace(
+            cfg.reputation, enabled=True, **rep_tweaks)
     cfg = cfg.replace(**overrides)
 
     fused_tamper = None

@@ -50,9 +50,29 @@ def _round_line(r) -> str:
     # all-eliminated (model-kept) round must be visible in the stream
     drop = f" dropped={r.dropped}" if r.dropped else ""
     deg = " DEGRADED" if r.degraded else ""
+    # peer-lifecycle observability (ROBUSTNESS.md §6): partition spans,
+    # heals, churn absences, and quarantined/probation peers in the stream
+    part = ""
+    if r.partition is not None:
+        comps = sorted(set(p for p in r.partition if p >= 0))
+        part = f" PARTITIONED x{len(comps)}"
+    if r.healed:
+        part += " HEALED"
+    gone = ([i for i, a in enumerate(r.churn_alive) if a == 0.0]
+            if r.churn_alive else [])
+    churn = f" churned_out={gone}" if gone else ""
+    rep = ""
+    if r.reputation_state is not None:
+        q = [i for i, s in enumerate(r.reputation_state)
+             if s == "quarantined"]
+        p = [i for i, s in enumerate(r.reputation_state) if s == "probation"]
+        if q:
+            rep += f" quarantined={q}"
+        if p:
+            rep += f" probation={p}"
     return (f"round {r.round:3d}: train_loss={r.train_loss:.4f} "
-            f"train_acc={r.train_acc:.4f}{acc}{anom}{rej}{drop}{deg} "
-            f"wall={r.wall_s:.2f}s")
+            f"train_acc={r.train_acc:.4f}{acc}{anom}{rej}{drop}{part}"
+            f"{churn}{rep}{deg} wall={r.wall_s:.2f}s")
 
 
 def _print_round(r) -> None:

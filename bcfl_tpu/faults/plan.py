@@ -27,7 +27,25 @@ into one seeded, deterministic, config-level schedule:
   the actual wire format, not a tree the network never carried,
 - **crash** — kill the round loop at a chosen round
   (:class:`SimulatedCrash`); a restart with ``resume=True`` must reproduce
-  the uninterrupted run bit-for-bit (tests/test_faults.py pins this).
+  the uninterrupted run bit-for-bit (tests/test_faults.py pins this),
+- **partition** — split the P2P mesh into isolated connected components for
+  a span of rounds (``partition_rounds`` x ``partition_groups`` or a seeded
+  ``partition_count``-way split). Each component aggregates independently
+  with the configured aggregator during the span; on heal the components
+  reconcile through the same rule (ROBUSTNESS.md §6). Expressed as
+  per-round component ids — the device mesh never reshapes,
+- **churn** — permanent client leave (``churn_leave``) and late join
+  (``churn_join``), expressed as per-round alive masks composed into the
+  participation mask exactly like dropout, except monotone: a departed
+  client never comes back and a late joiner is absent before its join
+  round. The mesh never reshapes; absent clients carry weight 0,
+- **flaky** — per-client *intermittent* corruption bursts: a fixed flaky
+  set (explicit ``flaky_clients`` or a seeded ``flaky_frac`` draw) corrupts
+  transport during multi-round burst windows (``flaky_burst_len`` rounds
+  per window, each window bad with ``flaky_on_prob``). This is the input
+  that makes reputation-driven quarantine (bcfl_tpu.reputation)
+  non-vacuous: the per-round Bernoulli ``corrupt_*`` lane has no repeat
+  offenders to remember.
 
 Everything is derived from ``(seed, fault lane, round)`` via
 ``np.random.default_rng`` — two engines with equal plans draw identical
@@ -62,6 +80,8 @@ class SimulatedCrash(RuntimeError):
 _LANE_DROPOUT = 1
 _LANE_STRAGGLER = 2
 _LANE_CORRUPT = 3
+_LANE_PARTITION = 4
+_LANE_FLAKY = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,6 +116,30 @@ class FaultPlan:
     # does not re-fire it — resume restarts at or before the crash round,
     # so re-firing would make the crash -> resume workflow unpassable
     crash_at_round: Optional[int] = None
+    # network partition: during `partition_rounds` the mesh splits into
+    # isolated components. `partition_groups` lists them explicitly (every
+    # client in exactly one group — validated against the client count by
+    # FaultInjector); alternatively `partition_count` >= 2 draws a stable
+    # seeded `count`-way split (constant across the whole plan, so the
+    # components never reshuffle mid-span).
+    partition_rounds: Optional[Tuple[int, ...]] = None
+    partition_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+    partition_count: int = 0
+    # churn: ((client, round), ...) schedules. A `churn_leave` client is
+    # gone from its round onward (permanently); a `churn_join` client is
+    # absent before its round (late join).
+    churn_leave: Optional[Tuple[Tuple[int, int], ...]] = None
+    churn_join: Optional[Tuple[Tuple[int, int], ...]] = None
+    # flaky peers: intermittent corruption bursts. The flaky set is
+    # `flaky_clients` plus a seeded `flaky_frac` draw; rounds are grouped
+    # into `flaky_burst_len`-round windows and each (window) is bad with
+    # `flaky_on_prob` (per-client draws), corrupting transport with
+    # `flaky_scale` for the whole window.
+    flaky_clients: Optional[Tuple[int, ...]] = None
+    flaky_frac: float = 0.0
+    flaky_burst_len: int = 3
+    flaky_on_prob: float = 0.5
+    flaky_scale: float = 1e6
 
     def __post_init__(self):
         for name in ("dropout_prob", "straggler_prob", "corrupt_prob"):
@@ -118,17 +162,116 @@ class FaultPlan:
         if self.crash_at_round is not None and self.crash_at_round < 0:
             raise ValueError(
                 f"crash_at_round must be >= 0, got {self.crash_at_round}")
+        # --- partition lane ---
+        if self.partition_groups is not None:
+            if not (isinstance(self.partition_groups, tuple) and all(
+                    isinstance(g, tuple) for g in self.partition_groups)):
+                raise ValueError(
+                    "partition_groups must be a tuple of client-index "
+                    "tuples (hashable — the plan lives inside the frozen "
+                    "FedConfig)")
+            if not self.partition_groups:
+                raise ValueError("partition_groups must name at least one "
+                                 "component (unlisted clients form one "
+                                 "extra component; the effective count is "
+                                 "validated against the client count by "
+                                 "FaultInjector)")
+            flat = [c for g in self.partition_groups for c in g]
+            if len(flat) != len(set(flat)) or any(c < 0 for c in flat):
+                raise ValueError(
+                    "partition_groups must be disjoint non-negative client "
+                    f"indices, got {self.partition_groups}")
+        if self.partition_count < 0 or self.partition_count == 1:
+            raise ValueError(
+                f"partition_count must be 0 (off) or >= 2, got "
+                f"{self.partition_count}")
+        if self.partition_groups is not None and self.partition_count:
+            raise ValueError("give partition_groups OR partition_count, "
+                             "not both")
+        if self.partitions and self.partition_rounds is None:
+            raise ValueError(
+                "a partition plan needs partition_rounds (the span of "
+                "rounds the mesh stays split)")
+        if self.partition_rounds is not None:
+            if not isinstance(self.partition_rounds, tuple):
+                raise ValueError("partition_rounds must be a tuple of round "
+                                 "indices")
+            if not self.partition_rounds:
+                # an empty span (e.g. a typo'd START:END with START >= END)
+                # would make every chaos-matrix partition check pass
+                # vacuously — the exact silent no-op this lane must not have
+                raise ValueError(
+                    "partition_rounds is empty: the partition would "
+                    "silently never fire (check the span bounds)")
+            if not self.partitions:
+                raise ValueError(
+                    "partition_rounds without partition_groups or "
+                    "partition_count would silently never partition")
+        # --- churn lane ---
+        for name in ("churn_leave", "churn_join"):
+            sched = getattr(self, name)
+            if sched is None:
+                continue
+            if not (isinstance(sched, tuple)
+                    and all(isinstance(e, tuple) and len(e) == 2
+                            and e[0] >= 0 and e[1] >= 0 for e in sched)):
+                raise ValueError(
+                    f"{name} must be a tuple of (client, round) pairs of "
+                    f"non-negative ints, got {sched!r}")
+            if len({c for c, _ in sched}) != len(sched):
+                raise ValueError(f"{name} lists a client twice: {sched!r}")
+        if self.churn_leave and self.churn_join:
+            j = dict((c, r) for c, r in self.churn_join)
+            for c, r in self.churn_leave:
+                if c in j and j[c] >= r:
+                    raise ValueError(
+                        f"client {c} would join at round {j[c]} after "
+                        f"leaving at round {r}; churn is permanent")
+        # --- flaky lane ---
+        if self.flaky_clients is not None and not isinstance(
+                self.flaky_clients, tuple):
+            raise ValueError("flaky_clients must be a tuple of client "
+                             "indices")
+        if not 0.0 <= self.flaky_frac <= 1.0:
+            raise ValueError(
+                f"flaky_frac must be in [0, 1], got {self.flaky_frac}")
+        if not 0.0 <= self.flaky_on_prob <= 1.0:
+            raise ValueError(
+                f"flaky_on_prob must be in [0, 1], got {self.flaky_on_prob}")
+        if self.flaky_burst_len < 1:
+            raise ValueError(
+                f"flaky_burst_len must be >= 1, got {self.flaky_burst_len}")
+        if not np.isfinite(self.flaky_scale):
+            raise ValueError("flaky_scale must be finite (same fingerprint-"
+                             "poisoning concern as corrupt_scale)")
 
     # ------------------------------------------------------------------ query
 
     @property
     def enabled(self) -> bool:
         return (self.dropout_prob > 0 or self.straggler_prob > 0
-                or self.corrupt_prob > 0 or self.crash_at_round is not None)
+                or self.corrupt_prob > 0 or self.crash_at_round is not None
+                or self.partitions or self.churns or self.flaky_enabled)
+
+    @property
+    def partitions(self) -> bool:
+        return (self.partition_groups is not None
+                or self.partition_count >= 2)
+
+    @property
+    def churns(self) -> bool:
+        return bool(self.churn_leave) or bool(self.churn_join)
+
+    @property
+    def flaky_enabled(self) -> bool:
+        return bool(self.flaky_clients) or self.flaky_frac > 0
 
     @property
     def corrupts(self) -> bool:
-        return self.corrupt_prob > 0
+        # flaky IS transport corruption (burst-scheduled), so every
+        # corruption-path requirement (mix_recv, faithful-mode rejection,
+        # tamper_hook exclusivity) applies to it identically
+        return self.corrupt_prob > 0 or self.flaky_enabled
 
     def _rng(self, lane: int, rnd: int) -> np.random.Generator:
         return np.random.default_rng((self.seed, lane, rnd))
@@ -171,6 +314,84 @@ class FaultPlan:
     def should_crash(self, rnd: int) -> bool:
         return self.crash_at_round is not None and rnd == self.crash_at_round
 
+    def partition_components(
+            self, rnd: int,
+            num_clients: int) -> Optional[Tuple[Tuple[int, ...], ...]]:
+        """The round's connected components as client-index tuples, or None
+        when the mesh is whole this round. The assignment is constant for
+        the whole plan (seeded once, not per round), so a multi-round span
+        keeps stable components and A/B seeds compare like the other
+        lanes."""
+        if not self.partitions or self.partition_rounds is None:
+            return None
+        if rnd not in self.partition_rounds:
+            return None
+        if self.partition_groups is not None:
+            groups = [list(g) for g in self.partition_groups]
+            covered = {c for g in groups for c in g}
+            rest = [c for c in range(num_clients) if c not in covered]
+            if rest:
+                # clients the spec doesn't mention form their own component
+                # (an explicit 2-group spec over 10 clients partitions the
+                # other 8 together, not out of existence)
+                groups.append(rest)
+            return tuple(tuple(g) for g in groups if g)
+        count = min(self.partition_count, num_clients)
+        perm = self._rng(_LANE_PARTITION, 0).permutation(num_clients)
+        groups = [sorted(int(c) for c in perm[i::count])
+                  for i in range(count)]
+        return tuple(tuple(g) for g in groups)
+
+    def churn_alive(self, rnd: int,
+                    num_clients: int) -> Optional[np.ndarray]:
+        """[C] float 0/1 alive-mask (0 = permanently left, or not yet
+        joined), or None when no churn is scheduled. Monotone per client:
+        once 0 by leave it stays 0; once 1 by join it stays 1 until (if
+        ever) its leave round."""
+        if not self.churns:
+            return None
+        alive = np.ones((num_clients,), np.float32)
+        for c, r in (self.churn_join or ()):
+            if c < num_clients and rnd < r:
+                alive[c] = 0.0
+        for c, r in (self.churn_leave or ()):
+            if c < num_clients and rnd >= r:
+                alive[c] = 0.0
+        return alive
+
+    def flaky_set(self, num_clients: int) -> np.ndarray:
+        """[C] bool: which clients are flaky (explicit list + seeded
+        fraction draw; constant for the whole plan)."""
+        flaky = np.zeros((num_clients,), bool)
+        for c in (self.flaky_clients or ()):
+            if c < num_clients:
+                flaky[c] = True
+        if self.flaky_frac > 0:
+            draw = self._rng(_LANE_FLAKY, 0).random(num_clients)
+            flaky |= draw < self.flaky_frac
+        return flaky
+
+    def flaky_scales(self, rnd: int,
+                     num_clients: int) -> Optional[np.ndarray]:
+        """[C] float32 additive transport-corruption scales from the flaky
+        lane (0 = clean), or None when no flaky client bursts this round.
+        Rounds are grouped into ``flaky_burst_len`` windows; each window is
+        independently bad per flaky client, so an offending client corrupts
+        for ``burst_len`` CONSECUTIVE rounds — the repeat-offender signature
+        reputation quarantine exists for."""
+        if not self.flaky_enabled:
+            return None
+        flaky = self.flaky_set(num_clients)
+        if not flaky.any():
+            return None
+        window = rnd // self.flaky_burst_len
+        # window draws come from lane (seed, FLAKY, 1 + window): offset by 1
+        # so they never collide with the flaky-set draw at (seed, FLAKY, 0)
+        draw = self._rng(_LANE_FLAKY, 1 + window).random(num_clients)
+        row = np.where(flaky & (draw < self.flaky_on_prob),
+                       self.flaky_scale, 0.0)
+        return row.astype(np.float32) if row.any() else None
+
 
 class FaultInjector:
     """Binds a :class:`FaultPlan` to one engine run (fixed client count) and
@@ -201,6 +422,34 @@ class FaultInjector:
                 "FaultPlan corruption and the legacy tamper_hook are two "
                 "transport models for the same updates — pick one (the "
                 "tamper_hook shim exists only for byte-level host tampering)")
+        p = self.plan
+        if p.partition_groups is not None:
+            bad = [c for g in p.partition_groups for c in g
+                   if c >= self.num_clients]
+            if bad:
+                raise ValueError(
+                    f"partition_groups name clients {bad} but the run has "
+                    f"only {self.num_clients} clients")
+            covered = {c for g in p.partition_groups for c in g}
+            rest = self.num_clients - len(covered)
+            if len(p.partition_groups) + (1 if rest else 0) < 2:
+                raise ValueError(
+                    "partition_groups split nothing: the spec covers every "
+                    f"client in {len(p.partition_groups)} component(s) and "
+                    "leaves no unlisted clients to form another — a "
+                    "partition needs >= 2 effective components")
+        if p.partition_count > self.num_clients:
+            raise ValueError(
+                f"partition_count {p.partition_count} > num_clients "
+                f"{self.num_clients}: components would be empty")
+        for name in ("churn_leave", "churn_join", "flaky_clients"):
+            sched = getattr(p, name) or ()
+            ids = [e[0] if isinstance(e, tuple) else e for e in sched]
+            bad = [c for c in ids if c >= self.num_clients]
+            if bad:
+                raise ValueError(
+                    f"{name} names clients {bad} but the run has only "
+                    f"{self.num_clients} clients")
 
     # thin per-round delegates (client count already bound)
     def dropout_keep(self, rnd: int) -> Optional[np.ndarray]:
@@ -210,15 +459,31 @@ class FaultInjector:
         return self.plan.straggler_delays(rnd, self.num_clients)
 
     def transport_scales(self, rnd: int) -> Optional[np.ndarray]:
-        return self.plan.transport_scales(rnd, self.num_clients)
+        """Per-round Bernoulli corruption + flaky burst corruption, summed:
+        both lanes are additive transport perturbations and ONE call site
+        decides 'is corruption scheduled' for the round."""
+        base = self.plan.transport_scales(rnd, self.num_clients)
+        flaky = self.plan.flaky_scales(rnd, self.num_clients)
+        if flaky is None:
+            return base
+        if base is None:
+            return flaky
+        return (base + flaky).astype(np.float32)
+
+    def partition_components(self, rnd: int):
+        return self.plan.partition_components(rnd, self.num_clients)
+
+    def churn_alive(self, rnd: int) -> Optional[np.ndarray]:
+        return self.plan.churn_alive(rnd, self.num_clients)
 
     def should_crash(self, rnd: int) -> bool:
         return self.plan.should_crash(rnd)
 
     def blocks_fusion(self) -> bool:
-        """Any scheduled plan fault forces the per-round path: dropout
-        perturbs the mask, stragglers and crashes need the host clock/loop
-        between rounds, and plan corruption runs the split-phase transport
+        """Any scheduled plan fault forces the per-round path: dropout,
+        churn, and partition perturb the mask/topology, stragglers and
+        crashes need the host clock/loop between rounds, and plan
+        corruption (incl. flaky bursts) runs the split-phase transport
         stage (the fused in-graph stage remains reachable via the
         ``fused_tamper`` shim, which does not block fusion)."""
         return self.plan.enabled

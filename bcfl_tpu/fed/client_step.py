@@ -150,6 +150,18 @@ def make_broadcast(mesh: ClientMesh) -> Callable:
     return broadcast
 
 
+def _adopt_pull(client_t: Tree, global_t: Tree, pull: jnp.ndarray) -> Tree:
+    """Pull-masked clients adopt the replicated ``global_t`` (broadcast
+    fused into the select); everyone else keeps their stacked row. THE
+    definition of the ``adopt`` program body — both impl builders wrap
+    exactly this, so the select semantics cannot drift between them."""
+    return jax.tree.map(
+        lambda x, g: jnp.where(
+            pull.reshape((-1,) + (1,) * (x.ndim - 1)) > 0,
+            jnp.broadcast_to(g, x.shape).astype(x.dtype), x),
+        client_t, global_t)
+
+
 def _exact_mean_spread(avg: Tree, new_t: Tree, mask: jnp.ndarray) -> Tree:
     """Serverless exact-mean aggregation: every unmasked client adopts the
     (mask-weighted) average, masked clients keep their own state. Shared by
@@ -223,6 +235,13 @@ class FedPrograms:
     # neighbor/aggregate terms from the TRANSPORTED tree, self-terms from
     # the honest local tree (gspmd impl only)
     mix_recv: Optional[Callable] = None
+    # (client_t, global_t, pull) -> client_t: pull-masked clients adopt the
+    # replicated global (broadcast fused into the select — ONE dispatch, no
+    # materialized [C, ...] broadcast buffer). Used by the async engine's
+    # post-merge pull and the chaos-partition scatter/heal (component
+    # members adopt their component aggregate / the reconciled global);
+    # both impls compile it.
+    adopt: Optional[Callable] = None
     # --- communication-compression programs (COMPRESSION.md; gspmd impl
     # only, present iff the builder's CompressionConfig is enabled). When
     # compression is on, the round/fused programs above change signature:
@@ -649,6 +668,13 @@ def _build_programs_dispatch(
         )
     )
 
+    adopt = jax.jit(
+        shard_map(
+            _adopt_pull, mesh=jmesh,
+            in_specs=(shard, repl, shard), out_specs=shard, check_vma=False,
+        )
+    )
+
     return FedPrograms(
         mesh=mesh,
         server_round=server_round,
@@ -666,6 +692,7 @@ def _build_programs_dispatch(
         local_updates=local_updates,
         mix_only=mix_only,
         single_update=single_update,
+        adopt=adopt,
         # impl-agnostic (plain global-array math); the fused *_fp twins are
         # gspmd-only, so a ledger run under shard_map falls back per-round
         fingerprint=jax.jit(lambda t: client_fingerprint(t)),
@@ -1021,6 +1048,11 @@ def _build_programs_gspmd(
         lambda t, w, fallback: _c(agg(t, w, fallback), repl),
         out_shardings=repl)
 
+    adopt = jax.jit(
+        lambda client_t, global_t, pull: _c(
+            _adopt_pull(client_t, global_t, pull), cl),
+        out_shardings=cl)
+
     # ---- split-phase codec programs (per-round ledger/corruption flow) ----
     # The engine composes these exactly like the uncompressed split-phase
     # sequence (client_updates -> commit -> transport -> verify ->
@@ -1075,6 +1107,7 @@ def _build_programs_gspmd(
         local_updates=local_updates,
         mix_only=mix_only,
         single_update=single_update,
+        adopt=adopt,
         fingerprint=jax.jit(lambda t: _c(client_fingerprint(t), cl),
                             out_shardings=cl),
         fingerprint_one=jax.jit(lambda t: tree_fingerprint(t)),

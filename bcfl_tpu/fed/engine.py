@@ -30,6 +30,19 @@ Fault injection (dropout / stragglers / corruption / host crash) is driven
 by ``cfg.faults`` (:class:`bcfl_tpu.faults.FaultPlan`); an all-eliminated
 round keeps the previous global model and is recorded ``degraded`` instead
 of emitting a 0/0 NaN mean.
+
+Peer lifecycle (ROBUSTNESS.md §6): ``cfg.reputation`` enables the
+HEALTHY -> SUSPECT -> QUARANTINED -> PROBATION state machine
+(:mod:`bcfl_tpu.reputation`) — per-round evidence (ledger-auth failures,
+anomaly flags, corruption hits, staleness) drives an EWMA trust score whose
+gate multiplier folds into the participation mask: quarantined peers carry
+weight 0 for a configurable window, probation peers a reduced vote weight.
+The chaos plan's **partition** lane routes the affected rounds through
+:meth:`_partitioned_round` (per-component aggregation over the stacked
+client view, robust reconciliation on heal); **churn** composes permanent
+leave / late join into the mask; **flaky** bursts ride the corruption
+transport stage. All of it is host-side mask/weight arithmetic feeding the
+already-compiled programs — no per-round retraces.
 """
 
 from __future__ import annotations
@@ -70,7 +83,13 @@ from bcfl_tpu.metrics import (
     trace,
 )
 from bcfl_tpu.models import TextClassifier, lora as lora_lib
-from bcfl_tpu.topology import anomaly_filter, random_graph, reference_graph
+from bcfl_tpu.reputation import ReputationTracker
+from bcfl_tpu.topology import (
+    anomaly_filter,
+    partitioned_anomaly_filter,
+    random_graph,
+    reference_graph,
+)
 from bcfl_tpu.topology.graph import LatencyGraph
 
 
@@ -134,6 +153,11 @@ class FedEngine:
         self.faults = FaultInjector(
             cfg.faults, cfg.num_clients,
             host_tamper=tamper_hook, fused_tamper=fused_tamper)
+        # peer-lifecycle reputation (bcfl_tpu.reputation): host-side state
+        # machine whose gate multiplier folds into each round's mask —
+        # None when disabled; state rides the checkpoint
+        self.reputation = (ReputationTracker(cfg.reputation, cfg.num_clients)
+                           if cfg.reputation.enabled else None)
         self.root_key = jax.random.key(cfg.seed,
                                        impl=cfg.resolved_prng_impl)
         # RESOLVED key impl: with prng_impl=None the run follows jax's
@@ -381,7 +405,14 @@ class FedEngine:
             jax.random.fold_in(self.root_key, 4), self.cfg.num_clients, rnd)
         return self.mesh.shard_clients(jax.random.key_data(keys))
 
-    def _participation(self, rnd: int) -> Dict:
+    def _participation(self, rnd: int, components=None) -> Dict:
+        if components is not None:
+            # under a chaos partition the filter sees each component's own
+            # subgraph — cross-component links don't exist for the span
+            return partitioned_anomaly_filter(
+                self.cfg.topology.anomaly_filter, self.graph, components,
+                protect=(self.info_source,),
+            )
         return anomaly_filter(
             self.cfg.topology.anomaly_filter, self.graph,
             protect=(self.info_source,),
@@ -566,9 +597,10 @@ class FedEngine:
 
     def _note_degraded(self, rec, participation: np.ndarray) -> None:
         """Mark (and warn about) a round whose every client was eliminated
-        by the anomaly gate x dropout x ledger auth — the aggregation
-        programs keep the previous params via their fallback input, so the
-        run continues NaN-free but made no progress this round."""
+        by the anomaly gate x dropout x churn x reputation x ledger auth —
+        the aggregation programs keep the previous params via their
+        fallback input, so the run continues NaN-free but made no progress
+        this round."""
         if float(np.asarray(participation).sum()) > 0.0:
             return
         rec.degraded = True
@@ -576,6 +608,153 @@ class FedEngine:
             "round %d: every client eliminated from the aggregate "
             "(mask/auth all zero) — keeping the previous global model",
             rec.round)
+
+    # --------------------------------------------------- partition round body
+
+    def _partitioned_round(self, rnd, trainable, stacked, mask, comps):
+        """One round under a chaos network partition (ROBUSTNESS.md §6).
+
+        The mesh never reshapes: every client still trains in the same
+        compiled ``local_updates`` dispatch, but aggregation runs PER
+        CONNECTED COMPONENT — each component's participants collapse through
+        the configured aggregator (robust rules included) and only the
+        component's members adopt its aggregate, so the components evolve as
+        genuinely independent federations for the span. ``trainable``
+        becomes the robust cross-component consensus (collapse over the
+        per-client component models, weighted by participation): the
+        eval/checkpoint view during the span and the reconciliation the
+        heal round adopts — never a silent global average of divergent
+        components, and a fully-eliminated component keeps its previous
+        model instead of NaN-ing out.
+
+        Composes with the ledger (split-phase commit/verify on what each
+        client shipped), compression (the wire quantity is the encoded
+        delta vs the client's round-start params — ``mode='local'``), and
+        transport corruption/flaky bursts. Everything here is pre-compiled
+        programs fed runtime masks/weights: zero per-round retraces."""
+        cfg = self.cfg
+        C = cfg.num_clients
+        batches, n_ex = self._round_batches(rnd)
+        rngs = self._rngs(rnd)
+        if stacked is None:
+            # span entry from server mode: every client starts the span
+            # from the last whole-mesh global
+            stacked = self.progs.broadcast(trainable)
+        start = stacked
+        stacked, stats = self.progs.local_updates(
+            stacked, self.frozen, batches, rngs)
+        rec = self._stats_to_rec(rnd, stats)
+        scales = self.faults.transport_scales(rnd)
+        auth = None
+        if self._comp is not None:
+            _, recon, auth = self._compressed_exchange(
+                rnd, stacked, start, rngs, scales, mode="local")
+            agg_src = recon
+        else:
+            sent = self._transport(stacked, scales)
+            if self.ledger is not None:
+                auth = self._ledger_verify(rnd, stacked, sent)
+            agg_src = sent
+        if auth is not None:
+            rec.auth = auth.tolist()
+            mask = mask * auth
+        w = np.asarray(mask, np.float32) * (
+            np.asarray(n_ex, np.float32) if cfg.weighted_agg else 1.0)
+        part_id = np.full((C,), -1, np.int64)
+        out = stacked
+        for ci, comp in enumerate(comps):
+            cm = np.zeros((C,), np.float32)
+            cm[list(comp)] = 1.0
+            part_id[list(comp)] = ci
+            wc = w * cm
+            if float(wc.sum()) <= 0.0:
+                # fully-eliminated component: in server mode its members
+                # keep the component's round-start model (identical rows by
+                # construction); serverless members keep their own
+                # post-train state, the existing all-masked semantics
+                if cfg.mode == "server":
+                    out = _tree_select(
+                        out, start, self.mesh.shard_clients(jnp.asarray(cm)))
+                logger.warning(
+                    "round %d: partition component %d fully eliminated — "
+                    "keeping its previous model", rnd, ci)
+                continue
+            comp_mean = self.progs.collapse(
+                agg_src, self.mesh.shard_clients(jnp.asarray(wc)), trainable)
+            if cfg.mode == "server":
+                pull = cm  # every member receives the component model
+            else:
+                # serverless: masked clients keep their own carried state
+                pull = cm * (np.asarray(mask) > 0)
+            out = self.progs.adopt(
+                out, comp_mean, self.mesh.shard_clients(jnp.asarray(
+                    pull, jnp.float32)))
+        # robust consensus ACROSS components (participation-weighted
+        # collapse over the per-client component models): the span's
+        # eval/checkpoint view and what the heal round reconciles onto
+        consensus = self.progs.collapse(
+            out, self.mesh.shard_clients(jnp.asarray(w)), trainable)
+        rec.partition = part_id.tolist()
+        self._note_degraded(rec, mask)
+        return consensus, out, rec
+
+    def _heal_partition(self, trainable, stacked, mask):
+        """First whole-mesh round after a partition span: the reconciled
+        global — the robust cross-component consensus the last partitioned
+        round computed — becomes the starting point. Server mode resumes
+        from it directly (the stacked per-component view is dropped);
+        serverless participants adopt it into their carried state. Either
+        way the components reconcile through the configured aggregator,
+        deterministically, rather than silently averaging divergent models
+        inside the next round's mix."""
+        if self.cfg.mode == "server":
+            return trainable, None
+        pull = self.mesh.shard_clients(jnp.asarray(
+            (np.asarray(mask) > 0).astype(np.float32)))
+        return trainable, self.progs.adopt(stacked, trainable, pull)
+
+    # ------------------------------------------------------ reputation bridge
+
+    def _reputation_observe(self, rnd: int, rec, gate: Dict) -> None:
+        """Fold this round's evidence into the peer-lifecycle tracker and
+        record the post-round states on the RoundRecord. Evidence sources
+        (combined per client by max, each weighted by the config):
+
+        - ledger-auth failure — the update that arrived failed chain
+          authentication (the hard, protocol-level evidence),
+        - anomaly-filter flag — the topology heuristics singled the peer out,
+        - injected corruption hit — the chaos plan corrupted this peer's
+          transport this round (the simulation's stand-in for a local
+          detector; coincides with auth failure when the ledger is on;
+          disable via reputation.observe_injected=False),
+        - async staleness beyond ``staleness_limit``.
+
+        Quarantined peers accrue nothing (they were excluded); the tracker
+        just ticks their sentence. Every input derives from seeded draws
+        and recorded round outputs, so the trajectory is deterministic and
+        crash/resume-stable."""
+        rcfg = self.cfg.reputation
+        C = self.cfg.num_clients
+        fault = np.zeros((C,), np.float64)
+        if rec.auth is not None:
+            failed = (np.asarray(rec.auth, np.float64) == 0.0)
+            fault = np.maximum(fault, rcfg.w_auth * failed)
+        if gate["anomalies"]:
+            flag = np.zeros((C,), np.float64)
+            flag[list(gate["anomalies"])] = 1.0
+            fault = np.maximum(fault, rcfg.w_anomaly * flag)
+        if rcfg.observe_injected:
+            scales = self.faults.transport_scales(rnd)  # deterministic redraw
+            if scales is not None:
+                hit = (np.asarray(scales, np.float64) != 0.0)
+                fault = np.maximum(fault, rcfg.w_corrupt * hit)
+        if rec.staleness is not None and rcfg.staleness_limit > 0:
+            stale = (np.asarray(rec.staleness, np.float64)
+                     > rcfg.staleness_limit)
+            fault = np.maximum(fault, rcfg.w_staleness * stale)
+        self.reputation.observe(fault)
+        rec.reputation_state = self.reputation.state_names()
+        rec.reputation_trust = [float(t) for t in self.reputation.trust]
 
     # ------------------------------------------------------------------- run
 
@@ -663,6 +842,13 @@ class FedEngine:
                 # replicate: a resumed tree left on the default device would
                 # re-trigger the round-2 recompile (tests/test_recompile.py)
                 trainable = self.mesh.replicate(_cast(state["trainable"]))
+                if (self.reputation is not None
+                        and state.get("rep_trust") is not None):
+                    # peer-lifecycle state travels with the checkpoint: a
+                    # resumed run must pick up every trust score, lifecycle
+                    # state, and quarantine timer exactly where the crash
+                    # left them (tests/test_reputation.py pins bit-equality)
+                    self.reputation.restore(state)
                 if ledger_json and self.ledger is not None:
                     self.ledger = Ledger.from_json(
                         ledger_json, cfg.ledger.use_native)
@@ -740,8 +926,9 @@ class FedEngine:
                     "tampering")
 
             t0 = time.time()
+            comps = self.faults.partition_components(rnd)
             with clock.phase("control_plane"):
-                gate = self._participation(rnd)
+                gate = self._participation(rnd, comps)
                 mask = gate["mask"].astype(np.float32)
                 # chaos dropout composes with the anomaly gate exactly like
                 # a second filter: the mesh never reshapes, dropped clients
@@ -752,10 +939,32 @@ class FedEngine:
                     dropped = [c for c in range(cfg.num_clients)
                                if keep[c] == 0.0]
                     mask = mask * keep
+                # churn: permanently-departed / not-yet-joined clients carry
+                # weight 0 — the monotone twin of dropout
+                alive = self.faults.churn_alive(rnd)
+                if alive is not None:
+                    mask = mask * alive
+                # reputation gate: quarantined peers 0, probation peers a
+                # reduced vote weight (bcfl_tpu.reputation)
+                if self.reputation is not None:
+                    mask = mask * self.reputation.gate()
+                healed = False
+                if (comps is None and stacked is not None and rnd > 0
+                        and self.faults.partition_components(rnd - 1)
+                        is not None):
+                    # partition span just ended: reconcile (derived from the
+                    # PLAN, not carried flags, so a resumed run heals at
+                    # exactly the same round as the uninterrupted one)
+                    trainable, stacked = self._heal_partition(
+                        trainable, stacked, mask)
+                    healed = True
 
             delays = self.faults.straggler_delays(rnd)
             with clock.phase("round_program"):
-                if cfg.sync == "async":
+                if comps is not None:
+                    trainable, stacked, rec = self._partitioned_round(
+                        rnd, trainable, stacked, mask, comps)
+                elif cfg.sync == "async":
                     trainable, stacked, rec = self._async_round(
                         rnd, trainable, stacked, mask, async_state,
                         delays=delays)
@@ -769,19 +978,42 @@ class FedEngine:
 
             rec.mask = mask.tolist()
             rec.anomalies = list(gate["anomalies"])
+            rec.healed = healed
             if dropped is not None:
                 rec.dropped = dropped
+            if alive is not None:
+                rec.churn_alive = alive.tolist()
             if delays is not None:
                 rec.straggler_s = delays.tolist()
+            # info passing: during a partition the source informs only its
+            # own component; churned-out clients are not targets either
+            # (the source itself always stays in the restricted set — a
+            # departed source degenerates to informing whoever remains,
+            # which with everyone else gone is (0, 0), not a crash)
+            restrict = None
+            if comps is not None:
+                restrict = list(next(
+                    c for c in comps if self.info_source in c))
+            if alive is not None:
+                base = (restrict if restrict is not None
+                        else range(cfg.num_clients))
+                restrict = [c for c in base
+                            if alive[c] > 0 or c == self.info_source]
             sync_t, async_t = self.graph.info_passing_time(
                 0.0, source=self.info_source, anomalies=gate["anomalies"],
                 extra_delay=delays,
                 payload_bytes=self._comms_payload_bytes(),
+                restrict=restrict,
             )
             rec.info_passing_sync_s = sync_t
             rec.info_passing_async_s = async_t
             rec.wall_s = time.time() - t0
 
+            if self.reputation is not None:
+                # evidence folds in BEFORE eval/checkpoint so the
+                # checkpointed tracker state matches the uninterrupted
+                # run's at every checkpoint boundary
+                self._reputation_observe(rnd, rec, gate)
             self._maybe_eval(rnd, rec, trainable, stacked, clock)
             metrics.rounds.append(rec)
             self._maybe_checkpoint(rnd, trainable, stacked)
@@ -808,6 +1040,8 @@ class FedEngine:
         if self.ledger is not None and len(self.ledger):
             metrics.ledger = self.ledger.payload_accounting()
             metrics.ledger["chain_ok"] = float(self.ledger.verify_chain() == -1)
+        if self.reputation is not None:
+            metrics.reputation = self.reputation.summary()
         return RunResult(metrics=metrics, trainable=trainable, params=params,
                          ledger=self.ledger)
 
@@ -865,6 +1099,10 @@ class FedEngine:
             "prng_impl_name": np.frombuffer(
                 self._prng_name.encode(), np.uint8).copy(),
         }
+        if self.reputation is not None:
+            # rep_trust / rep_state / rep_timer / counters: the peer
+            # lifecycle must resume exactly where the crash left it
+            state.update(self.reputation.checkpoint_state())
         save_checkpoint(
             cfg.checkpoint_dir, rnd, state,
             self.ledger.to_json() if self.ledger else None,
@@ -897,7 +1135,10 @@ class FedEngine:
                 or (cfg.mode != "server" and cfg.faithful)
                 or ledger_blocks or self.faults.host_tamper is not None
                 or self.faults.blocks_fusion()
+                or self.reputation is not None
                 or cfg.topology.anomaly_filter is not None):
+            # reputation needs the host between rounds: the lifecycle state
+            # machine consumes each round's evidence before gating the next
             return 1
         k = min(k, cfg.num_rounds - rnd)
         if cfg.eval_every:
@@ -1368,9 +1609,15 @@ class FedEngine:
         st["clock"] = float(st["next_done"][arrived].max()) if arrived else st["clock"]
 
         staleness = st["global_version"] - st["version"]
+        # staleness is reputation evidence (a chronically stale peer is a
+        # flaky peer) and run observability either way
+        rec.staleness = [max(int(s), 0) for s in staleness]
         alpha = np.zeros((cfg.num_clients,), np.float32)
         for c in arrived:
-            alpha[c] = cfg.staleness_decay ** max(int(staleness[c]), 0)
+            # mask[c] folds in the reputation gate: a probation peer's
+            # merge weight is scaled down exactly like its sync vote
+            alpha[c] = (float(mask[c])
+                        * cfg.staleness_decay ** max(int(staleness[c]), 0))
         rec.async_alpha = alpha.tolist()
         if self.cfg.weighted_agg:
             alpha = alpha * n_ex
@@ -1388,12 +1635,13 @@ class FedEngine:
             scale = self._async_merge_scale(alpha, arrived, n_ex)
             trainable = _tree_axpy(
                 trainable, merged_delta, cfg.async_server_lr * scale)
-            # arrived clients pull the fresh global and restart
+            # arrived clients pull the fresh global and restart (adopt
+            # fuses the broadcast into the select: one dispatch, no
+            # materialized [C, ...] broadcast buffer)
             pull = np.zeros((cfg.num_clients,), np.float32)
             pull[arrived] = 1.0
             pull_d = self.mesh.shard_clients(jnp.asarray(pull))
-            bcast = self.progs.broadcast(trainable)
-            stacked = _tree_select(stacked, bcast, pull_d)
+            stacked = self.progs.adopt(stacked, trainable, pull_d)
             st["global_version"] += 1
             for c in arrived:
                 st["version"][c] = st["global_version"]

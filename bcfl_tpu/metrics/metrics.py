@@ -83,6 +83,22 @@ class RoundRecord:
     # chaos plan this round / per-client injected straggler delay (seconds)
     dropped: Optional[List[int]] = None
     straggler_s: Optional[List[float]] = None
+    # chaos partition (ROBUSTNESS.md §6): per-client connected-component id
+    # this round (None = mesh whole); healed marks the first whole round
+    # after a span, where the components reconciled through the configured
+    # aggregator
+    partition: Optional[List[int]] = None
+    healed: bool = False
+    # chaos churn: per-client alive mask (0 = permanently left / not yet
+    # joined); None when no churn is scheduled
+    churn_alive: Optional[List[float]] = None
+    # peer lifecycle (bcfl_tpu.reputation): per-client state name and EWMA
+    # trust AFTER this round's evidence was folded in; None = reputation off
+    reputation_state: Optional[List[str]] = None
+    reputation_trust: Optional[List[float]] = None
+    # async staleness (global version - client version) at this aggregation
+    # event, for each client (async mode only)
+    staleness: Optional[List[int]] = None
     info_passing_sync_s: Optional[float] = None
     info_passing_async_s: Optional[float] = None
     # bytes-on-wire accounting (COMPRESSION.md): what this round's update
@@ -111,6 +127,9 @@ class RunMetrics:
     # communication accounting rollup: codec kind, per-round raw vs
     # bytes-on-wire, and the compression ratio (COMPRESSION.md)
     comms: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # peer-lifecycle rollup (bcfl_tpu.reputation.ReputationTracker.summary):
+    # final state/trust per client, quarantine event + round counts
+    reputation: Dict = dataclasses.field(default_factory=dict)
 
     @property
     def global_accuracies(self) -> List[float]:
@@ -126,6 +145,7 @@ class RunMetrics:
             "ledger": self.ledger,
             "phases": self.phases,
             "comms": self.comms,
+            "reputation": self.reputation,
             "global_accuracies": self.global_accuracies,
         }, indent=2)
 

@@ -1,0 +1,9 @@
+from bcfl_tpu.reputation.lifecycle import (  # noqa: F401
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    STATE_NAMES,
+    SUSPECT,
+    ReputationConfig,
+    ReputationTracker,
+)

@@ -1,0 +1,240 @@
+"""Peer lifecycle: reputation-driven quarantine with probation readmission.
+
+The anomaly filter and the ledger auth are *memoryless*: both recompute a
+participation mask from scratch every round, so a repeat offender is
+re-admitted the moment one round looks clean, and a peer that fails
+authentication nine rounds out of ten keeps costing a full train + commit +
+verify cycle forever. Serverless FL systems treat peer churn and partial
+trust as the default condition (flwr-serverless, arXiv:2310.15329); this
+module gives the engine the matching *memory*: a deterministic per-peer
+state machine
+
+    HEALTHY -> SUSPECT -> QUARANTINED -> PROBATION -> HEALTHY
+                  ^                          |
+                  +----- repeat offense -----+  (straight back to QUARANTINED)
+
+driven by an EWMA trust score that accumulates evidence the engine already
+produces each round — ledger-auth failures, anomaly-filter flags, chaos
+corruption hits, and async staleness (:meth:`FedEngine._reputation_observe`).
+
+Design constraints (the same contract as :mod:`bcfl_tpu.faults`):
+
+- **Pure host-side arrays.** Trust/state/timer are numpy arrays on the
+  control plane; what reaches the device mesh is only the participation
+  multiplier folded into the round's mask/weights — runtime inputs to the
+  already-compiled programs, so enabling reputation never retraces.
+- **Deterministic.** No RNG anywhere: the trajectory is a pure function of
+  the per-round evidence stream, which itself derives from seeded draws.
+  Two engines over equal configs walk identical lifecycles.
+- **Checkpointable.** ``checkpoint_state()``/``restore()`` round-trip the
+  full tracker through the engine checkpoint, so crash + resume + re-run
+  reproduces the uninterrupted run bit-for-bit (tests/test_reputation.py).
+
+Semantics of the multiplier (:meth:`ReputationTracker.gate`): QUARANTINED
+peers carry 0.0 (excluded from aggregation exactly like an anomaly-masked
+client — the mesh never reshapes); PROBATION peers carry
+``probation_weight`` (readmitted at reduced vote weight — a fractional
+weight in the mean/gossip aggregation paths; the Byzantine-robust order
+statistics treat any positive weight as full participation, so under
+trimmed_mean/median/krum probation means "participating again" and
+quarantine remains the exclusion mechanism); everyone else carries 1.0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+# lifecycle states (ints so the state vector checkpoints as a plain array)
+HEALTHY = 0
+SUSPECT = 1
+QUARANTINED = 2
+PROBATION = 3
+STATE_NAMES = ("healthy", "suspect", "quarantined", "probation")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReputationConfig:
+    """Knobs of the peer-lifecycle state machine. Defaults are tuned so a
+    single one-round glitch dips a peer to SUSPECT and recovers, while two
+    consecutive offenses cross the quarantine threshold:
+
+    trust' = (1 - ewma_alpha) * trust + ewma_alpha * (1 - fault)
+
+    with fault in [0, 1] per round. From trust 1.0 at alpha 0.4 one full
+    fault lands at 0.6 (suspect, above 0.4) and a second at 0.36
+    (quarantined)."""
+
+    enabled: bool = False
+    ewma_alpha: float = 0.4
+    # trust thresholds: below suspect_below -> SUSPECT, below
+    # quarantine_below -> QUARANTINED (must be ordered)
+    suspect_below: float = 0.7
+    quarantine_below: float = 0.4
+    # rounds a quarantined peer sits out before probation readmission
+    quarantine_rounds: int = 3
+    # clean rounds on probation before full HEALTHY status
+    probation_rounds: int = 2
+    # vote weight while on probation (mean/gossip paths; see module note)
+    probation_weight: float = 0.5
+    # a fault score >= this during PROBATION is a repeat offense: straight
+    # back to QUARANTINED without waiting for the EWMA to decay
+    strike_threshold: float = 0.5
+    # --- evidence weights (per-source fault score, combined by max) ---
+    w_auth: float = 1.0       # ledger-auth failure (the hard evidence)
+    w_corrupt: float = 1.0    # injected chaos corruption hit (see note)
+    w_anomaly: float = 0.5    # anomaly-filter flag (topology heuristic)
+    w_staleness: float = 0.25  # async staleness beyond staleness_limit
+    staleness_limit: int = 4  # 0 disables staleness evidence
+    # chaos corruption hits are ground truth the simulation harness knows
+    # because it injected them; counting them stands in for whatever local
+    # detector a real deployment runs (with the ledger on they coincide
+    # with auth failures anyway). Disable for "ledger-evidence-only" runs.
+    observe_injected: bool = True
+
+    def __post_init__(self):
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {self.ewma_alpha}")
+        if not 0.0 <= self.quarantine_below < self.suspect_below <= 1.0:
+            raise ValueError(
+                "thresholds must satisfy 0 <= quarantine_below < "
+                f"suspect_below <= 1, got {self.quarantine_below} / "
+                f"{self.suspect_below}")
+        if self.quarantine_rounds < 1 or self.probation_rounds < 1:
+            raise ValueError("quarantine_rounds and probation_rounds must "
+                             "be >= 1")
+        if not 0.0 < self.probation_weight <= 1.0:
+            # 0 would make probation indistinguishable from quarantine
+            raise ValueError(
+                f"probation_weight must be in (0, 1], got "
+                f"{self.probation_weight}")
+        for name in ("strike_threshold", "w_auth", "w_corrupt", "w_anomaly",
+                     "w_staleness"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v}")
+        if self.staleness_limit < 0:
+            raise ValueError(
+                f"staleness_limit must be >= 0, got {self.staleness_limit}")
+
+
+class ReputationTracker:
+    """Per-run lifecycle state for ``num_clients`` peers.
+
+    Call order per round (the engine's round loop):
+
+    1. ``gate()`` — the multiplier folded into this round's participation
+       mask (computed from the state BEFORE the round runs),
+    2. the round executes, producing evidence,
+    3. ``observe(fault)`` — fold the round's per-client fault scores into
+       the EWMA and advance the state machine (quarantine timers tick here;
+       quarantined peers accrue no evidence — they were excluded, so there
+       is nothing to observe).
+    """
+
+    def __init__(self, cfg: ReputationConfig, num_clients: int):
+        self.cfg = cfg
+        self.n = int(num_clients)
+        self.trust = np.ones((self.n,), np.float64)
+        self.state = np.full((self.n,), HEALTHY, np.int64)
+        self.timer = np.zeros((self.n,), np.int64)
+        # lifetime counters (ride the checkpoint so resumed rollups match)
+        self.quarantine_events = np.zeros((self.n,), np.int64)
+        self.rounds_quarantined = np.zeros((self.n,), np.int64)
+
+    # ------------------------------------------------------------------ gate
+
+    def gate(self) -> np.ndarray:
+        """[C] float32 multiplier for this round's participation mask:
+        0.0 quarantined, ``probation_weight`` on probation, 1.0 otherwise."""
+        mult = np.ones((self.n,), np.float32)
+        mult[self.state == QUARANTINED] = 0.0
+        mult[self.state == PROBATION] = np.float32(self.cfg.probation_weight)
+        return mult
+
+    # --------------------------------------------------------------- observe
+
+    def observe(self, fault: np.ndarray) -> None:
+        """Advance one round given per-client fault scores in [0, 1]
+        (0 = clean round, 1 = hard evidence like a failed ledger auth)."""
+        cfg = self.cfg
+        fault = np.clip(np.asarray(fault, np.float64), 0.0, 1.0)
+        for c in range(self.n):
+            if self.state[c] == QUARANTINED:
+                # excluded this round: no evidence, the sentence just ticks
+                self.rounds_quarantined[c] += 1
+                self.timer[c] -= 1
+                if self.timer[c] <= 0:
+                    self.state[c] = PROBATION
+                    self.timer[c] = cfg.probation_rounds
+                    # readmit at the suspect boundary: old (pre-quarantine)
+                    # trust must not instantly re-quarantine a peer the
+                    # window was supposed to give a second chance
+                    self.trust[c] = cfg.suspect_below
+                continue
+            a = cfg.ewma_alpha
+            self.trust[c] = (1.0 - a) * self.trust[c] + a * (1.0 - fault[c])
+            if self.state[c] == PROBATION:
+                if fault[c] >= cfg.strike_threshold:
+                    # repeat offense on probation: straight back inside
+                    self._quarantine(c)
+                else:
+                    self.timer[c] -= 1
+                    if self.timer[c] <= 0:
+                        self.state[c] = HEALTHY
+                continue
+            if self.trust[c] < cfg.quarantine_below:
+                self._quarantine(c)
+            elif self.trust[c] < cfg.suspect_below:
+                self.state[c] = SUSPECT
+            else:
+                self.state[c] = HEALTHY
+
+    def _quarantine(self, c: int) -> None:
+        self.state[c] = QUARANTINED
+        self.timer[c] = self.cfg.quarantine_rounds
+        self.trust[c] = min(self.trust[c], self.cfg.quarantine_below)
+        self.quarantine_events[c] += 1
+
+    # ------------------------------------------------------------ observability
+
+    def state_names(self) -> list:
+        return [STATE_NAMES[int(s)] for s in self.state]
+
+    def summary(self) -> Dict:
+        """Run-level rollup for ``RunMetrics.reputation``."""
+        return {
+            "final_state": self.state_names(),
+            "final_trust": [round(float(t), 6) for t in self.trust],
+            "quarantine_events": self.quarantine_events.tolist(),
+            "rounds_quarantined": self.rounds_quarantined.tolist(),
+            "total_quarantine_events": int(self.quarantine_events.sum()),
+        }
+
+    # -------------------------------------------------------------- checkpoint
+
+    def checkpoint_state(self) -> Dict[str, np.ndarray]:
+        """Arrays for the engine checkpoint (prefix ``rep_``) — restoring
+        them via :meth:`restore` makes crash/resume trajectories bit-equal
+        to the uninterrupted run."""
+        return {
+            "rep_trust": self.trust.copy(),
+            "rep_state": self.state.copy(),
+            "rep_timer": self.timer.copy(),
+            "rep_quarantine_events": self.quarantine_events.copy(),
+            "rep_rounds_quarantined": self.rounds_quarantined.copy(),
+        }
+
+    def restore(self, state: Dict) -> None:
+        self.trust = np.asarray(state["rep_trust"], np.float64).copy()
+        self.state = np.asarray(state["rep_state"], np.int64).copy()
+        self.timer = np.asarray(state["rep_timer"], np.int64).copy()
+        if state.get("rep_quarantine_events") is not None:
+            self.quarantine_events = np.asarray(
+                state["rep_quarantine_events"], np.int64).copy()
+        if state.get("rep_rounds_quarantined") is not None:
+            self.rounds_quarantined = np.asarray(
+                state["rep_rounds_quarantined"], np.int64).copy()

@@ -4,4 +4,8 @@ from bcfl_tpu.topology.graph import (  # noqa: F401
     reference_graph,
     random_graph,
 )
-from bcfl_tpu.topology.filters import anomaly_filter, FILTERS  # noqa: F401
+from bcfl_tpu.topology.filters import (  # noqa: F401
+    FILTERS,
+    anomaly_filter,
+    partitioned_anomaly_filter,
+)

@@ -122,7 +122,43 @@ def anomaly_filter(name: str | None, g: LatencyGraph,
     if name not in FILTERS:
         raise KeyError(f"unknown anomaly filter {name!r}; have {sorted(FILTERS)}")
     anomalies, scores = FILTERS[name](g)
-    anomalies = [a for a in anomalies if a not in protect]
-    mask = np.ones((g.n,), np.float32)
+    return _gate(anomalies, scores, g.n, protect)
+
+
+def _gate(anomalies, scores, n: int, protect: Tuple[int, ...]) -> Dict:
+    """The gating decision shape shared by the whole-mesh and partitioned
+    filters: protected nodes un-flagged, 0/1 mask derived from the rest."""
+    anomalies = sorted(set(int(a) for a in anomalies) - set(protect))
+    mask = np.ones((n,), np.float32)
     mask[list(anomalies)] = 0.0
     return {"anomalies": anomalies, "mask": mask, "scores": scores}
+
+
+def partitioned_anomaly_filter(
+        name: str | None, g: LatencyGraph,
+        components: Tuple[Tuple[int, ...], ...],
+        protect: Tuple[int, ...] = ()) -> Dict:
+    """:func:`anomaly_filter` under a chaos network partition
+    (faults.FaultPlan): each connected component sees ONLY its own subgraph
+    — weighted degrees, PageRank mass, and community structure all change
+    when the cross-component links vanish, so running the filter on the
+    whole graph during a partition would gate on a topology nobody can
+    observe. Filters run per component (singletons skipped: a 1-node graph
+    has no statistics) and anomaly indices map back through the subgraph's
+    sorted node order. Same return shape as :func:`anomaly_filter`; scores
+    are stitched into one global [n] vector."""
+    if name is None or name == "none":
+        return anomaly_filter(name, g, protect)
+    if name not in FILTERS:
+        raise KeyError(f"unknown anomaly filter {name!r}; have {sorted(FILTERS)}")
+    n = g.n
+    anomalies: List[int] = []
+    scores = np.zeros((n,), np.float64)
+    for comp in components:
+        nodes = sorted(int(c) for c in comp)
+        if len(nodes) < 2:
+            continue
+        sub_anoms, sub_scores = FILTERS[name](g.subgraph(nodes))
+        scores[nodes] = np.asarray(sub_scores, np.float64)
+        anomalies.extend(nodes[i] for i in sub_anoms)
+    return _gate(anomalies, scores, n, protect)

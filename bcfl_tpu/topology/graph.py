@@ -103,6 +103,16 @@ class LatencyGraph:
         finite = np.where(np.isfinite(u), u, 0.0)
         return finite.sum(axis=1)
 
+    def subgraph(self, nodes: Sequence[int]) -> "LatencyGraph":
+        """The latency graph restricted to ``nodes`` (sorted): the topology
+        one connected component sees during a chaos partition
+        (faults.FaultPlan partition lane) — cross-component links simply do
+        not exist for the span. Node ``i`` of the subgraph is
+        ``sorted(nodes)[i]`` of this graph; callers map filter/anomaly
+        indices back through that order."""
+        idx = np.asarray(sorted(int(n) for n in nodes))
+        return LatencyGraph(self.bandwidth_mbps[np.ix_(idx, idx)].copy())
+
     def shortest_path_times(
         self, payload_gb: float, keep: Optional[Sequence[int]] = None
     ) -> np.ndarray:
@@ -123,6 +133,7 @@ class LatencyGraph:
         anomalies: Iterable[int] = (),
         extra_delay: Optional[Sequence[float]] = None,
         payload_bytes: Optional[int] = None,
+        restrict: Optional[Sequence[int]] = None,
     ) -> Tuple[float, float]:
         """(synchronous, asynchronous) information-passing time from ``source``
         to every remaining node, after dropping ``anomalies``.
@@ -141,16 +152,31 @@ class LatencyGraph:
         communication-compression accounting (COMPRESSION.md) supplies the
         actual bytes-on-wire of the codec payload rather than a rounded GB
         figure.
+
+        ``restrict`` limits the reachable world to those nodes (original
+        ids; must include ``source``) — during a chaos partition
+        (faults.FaultPlan) information from the source reaches only its own
+        connected component, and the cross-component links don't exist even
+        as relays. A source alone in its component yields (0.0, 0.0): there
+        is nobody left to inform.
         """
         if payload_bytes is not None:
             payload_gb = payload_bytes / 1e9
         drop = set(int(a) for a in anomalies)
         if source in drop:
             raise ValueError(f"source node {source} is in the anomaly set")
+        if restrict is not None:
+            allowed = set(int(r) for r in restrict)
+            if source not in allowed:
+                raise ValueError(
+                    f"source node {source} is outside the restricted set")
+            drop |= set(range(self.n)) - allowed
         keep = [i for i in range(self.n) if i not in drop]
         times = self.shortest_path_times(payload_gb, keep)
         src = keep.index(source)
         t = np.delete(times[src], src)
+        if t.size == 0:
+            return 0.0, 0.0
         if extra_delay is not None:
             d = np.asarray(extra_delay, np.float64)[keep]
             t = t + np.delete(d, src)

@@ -367,22 +367,49 @@ def main():
         sys.exit(1)
 
 
+def _annotate_retry_line(line: Optional[str], attempts_used: int,
+                         backoffs: list):
+    """Stamp the retry provenance into the final JSON line: a
+    ``value: 0.0`` artifact with ``bench_attempts: 3`` is a wedge that
+    survived the full retry schedule; without these fields it is
+    indistinguishable from a never-retried single-shot failure (the two
+    consecutive zero BENCH artifacts that motivated this). Non-dict /
+    unparseable lines pass through untouched — the one-JSON-line contract
+    wins over the annotation."""
+    if line is None:
+        return None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return line
+    if not isinstance(rec, dict):
+        return line
+    rec["bench_attempts"] = attempts_used
+    rec["retry_backoff_s"] = backoffs
+    return json.dumps(rec)
+
+
 def _run_with_retries() -> int:
-    """Run the bench body in a child process, retrying on failure.
+    """Run the bench body in a child process, retrying with exponential
+    backoff on failure.
 
     A wedged tunnel at backend-init never recovers within a process, but a
-    fresh process minutes later often does (observed twice in r03). The
-    child is this same file with BCFL_BENCH_CHILD=1; only its final JSON
-    line is re-emitted, so the driver still sees exactly ONE JSON line.
+    fresh process minutes later often does (observed twice in r03) — the
+    backend-init stage is exactly the one that produced the "stage made no
+    progress within 300s" zero-value artifacts. The child is this same file
+    with BCFL_BENCH_CHILD=1; only its final JSON line is re-emitted (so the
+    driver still sees exactly ONE line), annotated with the attempt count
+    and the backoff schedule actually slept.
     """
     import subprocess
 
-    # envelope: 3 attempts x 300s wedged-init watchdog + 2 x 120s sleeps
-    # ~= 19 min worst case — the whole schedule must finish inside the
-    # DRIVER's own (unknown) process timeout or no JSON line survives
+    # envelope: 3 attempts x 300s wedged-init watchdog + (120 + 240)s
+    # backoff ~= 21 min worst case — the whole schedule must finish inside
+    # the DRIVER's own (unknown) process timeout or no JSON line survives
     attempts = int(os.environ.get("BCFL_BENCH_RETRIES", "2")) + 1
     delay = float(os.environ.get("BCFL_BENCH_RETRY_DELAY_S", "120"))
     last_line = None
+    backoffs: list = []
     for i in range(attempts):
         env = dict(os.environ, BCFL_BENCH_CHILD="1")
         proc = subprocess.run(
@@ -397,16 +424,24 @@ def _run_with_retries() -> int:
         except json.JSONDecodeError:
             failed = True
         if not failed:
-            print(last_line, flush=True)
+            print(_annotate_retry_line(last_line, i + 1, backoffs),
+                  flush=True)
             return 0
         print(f"bench attempt {i + 1}/{attempts} failed "
               f"(rc={proc.returncode}): "
               f"{(last_line or proc.stderr[-300:] or 'no output')[:300]}",
               file=sys.stderr, flush=True)
         if i < attempts - 1:
-            time.sleep(delay)
+            # exponential backoff: a just-wedged tunnel rarely recovers in
+            # the first window, and equal-spaced retries burned the whole
+            # schedule inside one wedge in r03
+            sleep_s = delay * (2 ** i)
+            backoffs.append(sleep_s)
+            time.sleep(sleep_s)
     if last_line:
-        print(last_line, flush=True)  # the error JSON — evidence survives
+        # the error JSON — evidence (with its retry provenance) survives
+        print(_annotate_retry_line(last_line, attempts, backoffs),
+              flush=True)
     else:
         _error_json("child", "bench child produced no output")
     return 1

@@ -43,7 +43,13 @@ print(d[0].device_kind)
 }
 
 run_bench() {  # $1 = mode, $2 = out file, [$3 = extra env "K=V"]
-  BCFL_BENCH_RETRIES=0 BCFL_BENCH_MODE="$1" ${3:+env "$3"} \
+  # retries default 0 here (this loop's healthy-window probing IS the
+  # outer retry; bench.py's own exponential-backoff schedule is for
+  # single-shot drivers) but stay overridable via BCFL_BENCH_RETRIES.
+  # Either way bench.py stamps bench_attempts/retry_backoff_s into the
+  # JSON line, so a recorded zero is distinguishable from a never-retried
+  # wedge.
+  BCFL_BENCH_RETRIES="${BCFL_BENCH_RETRIES:-0}" BCFL_BENCH_MODE="$1" ${3:+env "$3"} \
     timeout -k 10 7200 python bench.py > /tmp/bench_out_$1.txt 2>> "$LOG"
   cat /tmp/bench_out_$1.txt >> "$LOG"
   local line

@@ -1,16 +1,94 @@
 #!/bin/bash
-# Chaos smoke: run the fault-injection matrix (tests/test_faults.py) on the
-# virtual 8-device CPU mesh under the tier-1 timeout. The suite asserts the
+# Chaos smoke: run the fault-injection matrix (tests/test_faults.py) plus
+# the peer-lifecycle matrix (tests/test_reputation.py) on the virtual
+# 8-device CPU mesh under the tier-1 timeout. The suites assert the
 # ROBUSTNESS.md contracts: no NaN/Inf under any injected fault class,
 # corrupted updates auth-masked out of the aggregate, crash+resume
 # bit-identical to the uninterrupted run, robust aggregators compiled into
-# the round program without per-round retraces, and truncated-checkpoint
-# fallback. The same tests ride the standard tier-1 command (they are
-# `not slow`); this script is the focused entrypoint for chaos work.
+# the round program without per-round retraces, truncated-checkpoint
+# fallback, and (§6) partition/churn/flaky handling with reputation-driven
+# quarantine. The same tests ride the standard tier-1 command (they are
+# `not slow`); this script is the focused entrypoint for chaos work, and it
+# ends with a per-lane fault/quarantine summary table from one live
+# all-lanes engine run.
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
-set -euo pipefail
+set -uo pipefail
 cd "$(dirname "$0")/.."
-exec timeout -k 10 870 env JAX_PLATFORMS=cpu \
-    python -m pytest tests/test_faults.py -q -m 'faults and not slow' \
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_faults.py tests/test_reputation.py -q \
+    -m '(faults or reputation) and not slow' \
     -p no:cacheprovider "$@"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "chaos suite FAILED (rc=$rc); skipping the summary run" >&2
+  exit "$rc"
+fi
+
+# Per-lane summary: one short engine run with every lane armed (dropout,
+# straggler, flaky corruption bursts, partition, churn, reputation, ledger)
+# and a table of what each lane actually did. Deterministic — same seeds,
+# same table, every run.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import tests.conftest  # noqa: F401  (8-device CPU mesh)
+from bcfl_tpu.config import FedConfig, LedgerConfig, PartitionConfig
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.fed.engine import FedEngine
+from bcfl_tpu.reputation import ReputationConfig
+
+cfg = FedConfig(
+    dataset="synthetic", model="tiny-bert", num_clients=4, num_rounds=8,
+    seq_len=16, batch_size=4, max_local_batches=2, mode="server",
+    eval_every=0, partition=PartitionConfig(kind="iid", iid_samples=8),
+    ledger=LedgerConfig(enabled=True),
+    reputation=ReputationConfig(enabled=True, quarantine_rounds=2),
+    faults=FaultPlan(
+        seed=1, dropout_prob=0.2, straggler_prob=0.2,
+        straggler_delay_s=30.0,
+        partition_groups=((0, 1), (2, 3)), partition_rounds=(2, 3),
+        churn_leave=((3, 6),),
+        flaky_clients=(1,), flaky_burst_len=2, flaky_on_prob=0.7),
+)
+eng = FedEngine(cfg)
+res = eng.run()
+recs = res.metrics.rounds
+C = cfg.num_clients
+
+dropped = sum(len(r.dropped or []) for r in recs)
+straggled = sum(sum(1 for s in (r.straggler_s or []) if s > 0) for r in recs)
+corrupt_rounds = sum(
+    1 for r in range(cfg.num_rounds)
+    if eng.faults.transport_scales(r) is not None)
+auth_fail = sum(sum(1 for a in (r.auth or []) if a == 0.0) for r in recs)
+part_rounds = sum(1 for r in recs if r.partition is not None)
+healed = sum(1 for r in recs if r.healed)
+churned = sum(
+    sum(1 for a in (r.churn_alive or []) if a == 0.0) for r in recs)
+quarantined_rounds = sum(
+    sum(1 for s in (r.reputation_state or []) if s == "quarantined")
+    for r in recs)
+degraded = sum(1 for r in recs if r.degraded)
+rep = res.metrics.reputation
+
+print()
+print("chaos smoke summary — %d rounds x %d clients (all lanes armed)"
+      % (cfg.num_rounds, C))
+print("%-12s | %-44s" % ("lane", "observed"))
+print("-" * 60)
+print("%-12s | %d client-round dropouts" % ("dropout", dropped))
+print("%-12s | %d client-round straggler delays" % ("straggler", straggled))
+print("%-12s | %d corrupting rounds (flaky bursts), %d auth rejections"
+      % ("flaky", corrupt_rounds, auth_fail))
+print("%-12s | %d partitioned rounds, %d heal round(s)"
+      % ("partition", part_rounds, healed))
+print("%-12s | %d client-round absences" % ("churn", churned))
+print("%-12s | %d quarantine events, %d client-rounds quarantined, "
+      "final states %s"
+      % ("reputation", rep["total_quarantine_events"], quarantined_rounds,
+         rep["final_state"]))
+print("%-12s | %d degraded (model-kept) rounds, ledger chain %s"
+      % ("engine", degraded,
+         "OK" if res.ledger.verify_chain() == -1 else "BROKEN"))
+EOF
+exit $?

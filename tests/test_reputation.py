@@ -1,0 +1,426 @@
+"""Peer-lifecycle chaos matrix: reputation-driven quarantine
+(bcfl_tpu.reputation) and the partition / churn / flaky fault lanes
+(bcfl_tpu.faults) against the engine's ROBUSTNESS.md §6 contracts.
+
+Pinned here:
+
+- a partitioned span degrades to per-component aggregation and reconciles
+  deterministically on heal (no NaN, no silent global average of divergent
+  components),
+- a flaky repeat offender is quarantined within the configured window,
+  excluded from aggregation while quarantined, and readmitted on probation
+  at reduced weight — while a single-round glitch is never quarantined,
+- churn (permanent leave / late join) is a pure mask schedule: the mesh
+  never reshapes, absent clients carry weight 0,
+- crash + restore + re-run under partition + churn + flaky reproduces the
+  uninterrupted run BIT-FOR-BIT, with reputation state restored from the
+  checkpoint, composing with aggregator=trimmed_mean, compress=int8+topk,
+  and the ledger — at zero per-round retraces.
+
+Rides the tier-1 chaos matrix (marker ``faults``, plus the focused
+``reputation`` marker — ``scripts/chaos_smoke.sh`` runs both).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from bcfl_tpu.config import FedConfig, LedgerConfig, PartitionConfig
+from bcfl_tpu.faults import FaultInjector, FaultPlan, SimulatedCrash
+from bcfl_tpu.fed.engine import FedEngine
+from bcfl_tpu.reputation import (
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    SUSPECT,
+    ReputationConfig,
+    ReputationTracker,
+)
+
+pytestmark = [pytest.mark.faults, pytest.mark.reputation]
+
+
+def _tiny(**kw):
+    """Same smallest-config shape as tests/test_faults.py so the memoized
+    round programs (and the persistent XLA cache) are shared across the
+    chaos matrix."""
+    base = dict(
+        dataset="synthetic", model="tiny-bert", num_clients=4, num_rounds=3,
+        seq_len=16, batch_size=4, max_local_batches=2,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _leaves(tree):
+    return jax.tree.leaves(jax.device_get(tree))
+
+
+def _assert_finite(tree):
+    for x in _leaves(tree):
+        assert np.isfinite(np.asarray(x)).all(), "NaN/Inf in global model"
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ----------------------------------------------------------------- plan lanes
+
+
+def test_partition_lane_deterministic_and_validated():
+    plan = FaultPlan(partition_groups=((0, 1), (2, 3)),
+                     partition_rounds=(1, 2))
+    assert plan.partitions and plan.enabled
+    assert plan.partition_components(0, 4) is None
+    assert plan.partition_components(1, 4) == ((0, 1), (2, 3))
+    # unlisted clients form their own component, never vanish
+    assert plan.partition_components(1, 6) == ((0, 1), (2, 3), (4, 5))
+    # seeded split: stable across the span, every component non-empty,
+    # different seeds give different splits
+    p = FaultPlan(seed=3, partition_count=2, partition_rounds=(0, 1, 2))
+    comps = p.partition_components(0, 8)
+    assert comps == p.partition_components(2, 8)
+    assert sorted(c for g in comps for c in g) == list(range(8))
+    assert len(comps) == 2 and all(g for g in comps)
+    q = FaultPlan(seed=4, partition_count=2, partition_rounds=(0, 1, 2))
+    assert any(q.partition_components(0, 8) != p.partition_components(0, 8)
+               for _ in range(1))
+    # a single explicit group is fine: unlisted clients form the other side
+    half = FaultPlan(partition_groups=((0, 1),), partition_rounds=(0,))
+    assert half.partition_components(0, 4) == ((0, 1), (2, 3))
+    # validation
+    with pytest.raises(ValueError, match="disjoint"):
+        FaultPlan(partition_groups=((0, 1), (1, 2)), partition_rounds=(0,))
+    with pytest.raises(ValueError, match="partition_rounds"):
+        FaultPlan(partition_groups=((0,), (1,)))
+    with pytest.raises(ValueError, match="empty"):
+        # a typo'd START:END span collapsing to () must not pass vacuously
+        FaultPlan(partition_groups=((0,), (1,)), partition_rounds=())
+    with pytest.raises(ValueError, match="not both"):
+        FaultPlan(partition_groups=((0,), (1,)), partition_count=2,
+                  partition_rounds=(0,))
+    with pytest.raises(ValueError, match="only 4 clients"):
+        FaultInjector(FaultPlan(partition_groups=((0,), (9,)),
+                                partition_rounds=(0,)), 4)
+    with pytest.raises(ValueError, match="effective components"):
+        # one group covering every client splits nothing
+        FaultInjector(FaultPlan(partition_groups=((0, 1, 2, 3),),
+                                partition_rounds=(0,)), 4)
+
+
+def test_churn_schedule_is_monotone_mask():
+    plan = FaultPlan(churn_leave=((3, 2),), churn_join=((0, 1),))
+    assert plan.churns and plan.enabled
+    rows = [plan.churn_alive(r, 4).tolist() for r in range(4)]
+    assert rows[0] == [0.0, 1.0, 1.0, 1.0]   # 0 not yet joined
+    assert rows[1] == [1.0, 1.0, 1.0, 1.0]   # 0 joined, 3 still here
+    assert rows[2] == [1.0, 1.0, 1.0, 0.0]   # 3 left permanently
+    assert rows[3] == [1.0, 1.0, 1.0, 0.0]
+    assert FaultPlan().churn_alive(0, 4) is None
+    with pytest.raises(ValueError, match="permanent"):
+        FaultPlan(churn_leave=((1, 2),), churn_join=((1, 3),))
+    with pytest.raises(ValueError, match="twice"):
+        FaultPlan(churn_leave=((1, 2), (1, 3)))
+
+
+def test_flaky_bursts_are_multi_round_and_seeded():
+    plan = FaultPlan(seed=7, flaky_clients=(1,), flaky_burst_len=3,
+                     flaky_on_prob=0.5, flaky_scale=42.0)
+    assert plan.flaky_enabled and plan.corrupts and plan.enabled
+    rows = [plan.flaky_scales(r, 4) for r in range(12)]
+    # deterministic: a second draw reproduces the schedule exactly
+    for r, row in enumerate(rows):
+        again = plan.flaky_scales(r, 4)
+        if row is None:
+            assert again is None
+        else:
+            np.testing.assert_array_equal(row, again)
+    # burst windows are whole: within a 3-round window the client is either
+    # bad for all 3 rounds or clean for all 3
+    for w in range(4):
+        vals = {tuple(r.tolist()) if r is not None else None
+                for r in rows[3 * w:3 * w + 3]}
+        assert len(vals) == 1, f"window {w} not constant: {vals}"
+    # at p=0.5 over 4 windows the seeded schedule has both bursts and gaps
+    assert any(r is not None for r in rows), "flaky lane never fired"
+    assert any(r is None for r in rows), "flaky lane always on at p=0.5"
+    # only the flaky client is ever corrupted
+    for row in rows:
+        if row is not None:
+            assert row[1] == 42.0 and row[0] == row[2] == row[3] == 0.0
+    # the injector merges flaky into the one transport_scales call site
+    inj = FaultInjector(plan, 4)
+    burst = next(r for r in range(12)
+                 if plan.flaky_scales(r, 4) is not None)
+    np.testing.assert_array_equal(inj.transport_scales(burst),
+                                  plan.flaky_scales(burst, 4))
+
+
+# ------------------------------------------------------------- state machine
+
+
+def test_lifecycle_repeat_offender_vs_single_glitch():
+    cfg = ReputationConfig(enabled=True, quarantine_rounds=2,
+                           probation_rounds=2)
+    t = ReputationTracker(cfg, 2)
+    # client 1 offends twice -> SUSPECT then QUARANTINED; client 0 clean
+    t.observe(np.asarray([0.0, 1.0]))
+    assert t.state.tolist() == [HEALTHY, SUSPECT]
+    t.observe(np.asarray([0.0, 1.0]))
+    assert t.state.tolist() == [HEALTHY, QUARANTINED]
+    assert t.gate().tolist() == [1.0, 0.0]
+    # sentence ticks evidence-free, then probation at reduced weight
+    t.observe(np.zeros(2))
+    t.observe(np.zeros(2))
+    assert t.state.tolist() == [HEALTHY, PROBATION]
+    assert t.gate().tolist() == [1.0, cfg.probation_weight]
+    # a strike on probation goes straight back to quarantine
+    t.observe(np.asarray([0.0, 1.0]))
+    assert t.state.tolist() == [HEALTHY, QUARANTINED]
+    assert t.quarantine_events.tolist() == [0, 2]
+    # single glitch on a fresh tracker: suspect, then recovery — never
+    # quarantined
+    t2 = ReputationTracker(cfg, 1)
+    t2.observe(np.asarray([1.0]))
+    assert t2.state.tolist() == [SUSPECT]
+    for _ in range(3):
+        t2.observe(np.zeros(1))
+    assert t2.state.tolist() == [HEALTHY]
+    assert t2.quarantine_events.tolist() == [0]
+    # checkpoint round-trip is exact
+    t3 = ReputationTracker(cfg, 2)
+    t3.restore(t.checkpoint_state())
+    np.testing.assert_array_equal(t3.trust, t.trust)
+    np.testing.assert_array_equal(t3.state, t.state)
+    np.testing.assert_array_equal(t3.timer, t.timer)
+
+
+def test_reputation_config_validation():
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        ReputationConfig(ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="thresholds"):
+        ReputationConfig(suspect_below=0.3, quarantine_below=0.5)
+    with pytest.raises(ValueError, match="probation_weight"):
+        ReputationConfig(probation_weight=0.0)
+
+
+# ------------------------------------------------------- engine: quarantine
+
+
+def test_flaky_repeat_offender_quarantined_glitch_is_not():
+    """The headline contract: with the ledger producing the evidence, a
+    client that fails auth two rounds running is quarantined (mask 0 for
+    the window), readmitted on probation at reduced weight, and healthy
+    after serving it; a single-round glitch only ever reaches SUSPECT."""
+    rep = ReputationConfig(enabled=True, quarantine_rounds=2,
+                           probation_rounds=2, probation_weight=0.5)
+    offender = _tiny(
+        mode="server", num_rounds=7, eval_every=0,
+        ledger=LedgerConfig(enabled=True), reputation=rep,
+        faults=FaultPlan(corrupt_prob=1.0, corrupt_rounds=(0, 1),
+                         corrupt_scale=1e6))
+    eng = FedEngine(offender)
+    assert eng._chunk_rounds(0) == 1  # reputation forces the per-round path
+    res = eng.run()
+    recs = res.metrics.rounds
+    # rounds 0-1: every client fails auth (corrupt_prob=1) -> trust
+    # 1.0 -> 0.6 -> 0.36: quarantined from round 2, within the window
+    assert recs[0].reputation_state == ["suspect"] * 4
+    assert recs[1].reputation_state == ["quarantined"] * 4
+    for r in (2, 3):
+        assert recs[r].mask == [0.0] * 4          # excluded while inside
+        assert recs[r].degraded is True           # nobody left to aggregate
+    assert recs[3].reputation_state == ["probation"] * 4
+    for r in (4, 5):
+        assert recs[r].mask == [0.5] * 4          # probation vote weight
+    assert recs[5].reputation_state == ["healthy"] * 4
+    assert recs[6].mask == [1.0] * 4
+    _assert_finite(res.trainable)
+    roll = res.metrics.reputation
+    assert roll["total_quarantine_events"] == 4
+    assert roll["rounds_quarantined"] == [2] * 4
+
+    # contrast: ONE bad round is a glitch — suspect, recover, never
+    # quarantined, never excluded
+    glitch = FedEngine(offender.replace(
+        faults=FaultPlan(corrupt_prob=1.0, corrupt_rounds=(0,),
+                         corrupt_scale=1e6))).run()
+    assert glitch.metrics.rounds[0].reputation_state == ["suspect"] * 4
+    assert glitch.metrics.reputation["total_quarantine_events"] == 0
+    for r in glitch.metrics.rounds:
+        assert all(m > 0.0 for m in r.mask), "glitch must never exclude"
+
+
+# -------------------------------------------------------- engine: partition
+
+
+def test_partitioned_round_aggregates_per_component():
+    """During a partitioned round each component converges to ITS OWN
+    aggregate: rows agree within a component, differ across components, and
+    nothing NaNs. The consensus view is the robust cross-component
+    reconciliation, not a fresh global average of raw client updates."""
+    eng = FedEngine(_tiny(mode="server", num_rounds=1, faults=FaultPlan(
+        partition_groups=((0, 1), (2, 3)), partition_rounds=(0,))))
+    comps = eng.faults.partition_components(0)
+    consensus, out, rec = eng._partitioned_round(
+        0, eng.trainable0, None, np.ones(4, np.float32), comps)
+    assert rec.partition == [0, 0, 1, 1]
+    _assert_finite(out)
+    _assert_finite(consensus)
+    host = jax.device_get(out)
+    leaf = np.asarray(jax.tree.leaves(host)[0])
+    np.testing.assert_array_equal(leaf[0], leaf[1])  # same component
+    np.testing.assert_array_equal(leaf[2], leaf[3])
+    assert not np.array_equal(leaf[0], leaf[2]), (
+        "components silently shared an aggregate across the partition")
+
+
+def test_partition_span_heals_deterministically():
+    """A full run through a partition span: partitioned rounds record
+    component ids, the first whole round records healed=True, the final
+    model is finite, and two identical runs are bit-identical (the
+    reconciliation is deterministic)."""
+    cfg = _tiny(mode="server", num_rounds=4, eval_every=0,
+                faults=FaultPlan(partition_groups=((0, 1), (2, 3)),
+                                 partition_rounds=(1, 2)))
+    res_a = FedEngine(cfg).run()
+    recs = res_a.metrics.rounds
+    assert recs[0].partition is None and recs[3].partition is None
+    assert recs[1].partition == [0, 0, 1, 1]
+    assert recs[2].partition == [0, 0, 1, 1]
+    assert [r.healed for r in recs] == [False, False, False, True]
+    _assert_finite(res_a.trainable)
+    res_b = FedEngine(cfg).run()
+    _assert_trees_equal(res_a.trainable, res_b.trainable)
+    # the partition changed the outcome vs the unpartitioned run (the spans
+    # really did aggregate independently)
+    res_c = FedEngine(_tiny(mode="server", num_rounds=4, eval_every=0)).run()
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(_leaves(res_a.trainable), _leaves(res_c.trainable)))
+
+
+def test_partitioned_info_passing_restricted_to_component():
+    """Information from the source crosses only its own component during a
+    partition: sync time shrinks to the in-component targets."""
+    cfg = _tiny(mode="serverless", num_rounds=2,
+                topology=dataclasses.replace(_tiny().topology,
+                                             gossip_steps=0),
+                faults=FaultPlan(partition_groups=((0, 1), (2, 3)),
+                                 partition_rounds=(0,)))
+    res = FedEngine(cfg).run()
+    r0, r1 = res.metrics.rounds
+    assert r0.info_passing_sync_s < r1.info_passing_sync_s
+    assert r1.healed is True
+
+
+def test_partition_rejected_where_it_cannot_aggregate():
+    plan = FaultPlan(partition_groups=((0, 1), (2, 3)),
+                     partition_rounds=(0,))
+    with pytest.raises(ValueError, match="async"):
+        _tiny(sync="async", faults=plan)
+    with pytest.raises(ValueError, match="faithful"):
+        _tiny(mode="serverless", faithful=True, faults=plan)
+    with pytest.raises(ValueError, match="gossip_steps"):
+        _tiny(mode="serverless", faults=plan)  # default ring diffusion
+
+
+# ------------------------------------------------------------ engine: churn
+
+
+def test_churn_leave_and_late_join_are_mask_schedules():
+    cfg = _tiny(mode="server", num_rounds=3, eval_every=0,
+                faults=FaultPlan(churn_leave=((3, 1),),
+                                 churn_join=((0, 1),)))
+    res = FedEngine(cfg).run()
+    recs = res.metrics.rounds
+    assert recs[0].churn_alive == [0.0, 1.0, 1.0, 1.0]
+    assert recs[0].mask[0] == 0.0            # not yet joined
+    assert recs[1].churn_alive == [1.0, 1.0, 1.0, 0.0]
+    assert recs[1].mask == [1.0, 1.0, 1.0, 0.0]
+    assert recs[2].mask[3] == 0.0            # leave is permanent
+    _assert_finite(res.trainable)
+
+
+# ------------------------------------- composition: the §6 chaos-matrix case
+
+
+def test_partition_churn_flaky_crash_resume_bit_identical(tmp_path):
+    """The composition contract in one chaos-matrix case: partition + churn
+    + flaky with aggregator=trimmed_mean, compress=int8+topk, and the
+    ledger on — zero per-round retraces, and crash + restore + re-run
+    reproduces the uninterrupted run bit-for-bit with reputation state
+    carried in the checkpoint.
+
+    The trimmed_mean x int8+topk program set is unique to this test, so the
+    jit cache sizes below count exactly this test's traces — asserted ==1
+    AFTER three engine runs (uninterrupted, crashed, resumed), which pins
+    both zero per-ROUND retraces and zero per-ENGINE recompiles (masks,
+    weights, components, and reputation gates are all runtime inputs)."""
+    from bcfl_tpu.compression import CompressionConfig
+
+    base = _tiny(
+        mode="server", num_rounds=5, eval_every=0,
+        aggregator="trimmed_mean",
+        compression=CompressionConfig(kind="int8+topk"),
+        ledger=LedgerConfig(enabled=True),
+        reputation=ReputationConfig(enabled=True, quarantine_rounds=2),
+        faults=FaultPlan(
+            seed=11,
+            partition_groups=((0, 1), (2, 3)), partition_rounds=(1, 2),
+            churn_leave=((2, 4),), churn_join=((3, 1),),
+            flaky_clients=(1,), flaky_burst_len=2, flaky_on_prob=1.0),
+        checkpoint_dir=str(tmp_path / "a"), checkpoint_every=1)
+    eng_a = FedEngine(base)
+    res_a = eng_a.run()
+    # the lanes actually fired
+    assert any(r.partition for r in res_a.metrics.rounds)
+    assert any(r.auth and 0.0 in r.auth for r in res_a.metrics.rounds)
+    assert res_a.metrics.reputation["total_quarantine_events"] >= 1
+    _assert_finite(res_a.trainable)
+
+    crash = base.replace(
+        checkpoint_dir=str(tmp_path / "b"),
+        faults=dataclasses.replace(base.faults, crash_at_round=3))
+    with pytest.raises(SimulatedCrash):
+        FedEngine(crash).run()
+    eng_b = FedEngine(crash)
+    res_b = eng_b.run(resume=True)
+    # zero per-round retraces: every program the chaos round bodies touch
+    # traced exactly once across three engines x 5 rounds (partitioned AND
+    # whole-mesh, quarantine on AND off). encode_deltas_local shares its
+    # underlying jit with encode_deltas (jax dedupes jit() of the same
+    # function), so it carries one trace per delta-REFERENCE kind —
+    # replicated global (whole-mesh server rounds) + stacked round-start
+    # (partitioned rounds) — a constant 2, not a per-round count.
+    for eng in (eng_a, eng_b):
+        for name in ("local_updates", "client_updates", "collapse", "adopt",
+                     "encode_deltas_local", "fingerprint",
+                     "corrupt_payload"):
+            prog = getattr(eng.progs, name)
+            want = 2 if name == "encode_deltas_local" else 1
+            assert prog._cache_size() == want, (name, prog._cache_size())
+    # resumed mid-lifecycle: rounds 3-4 re-run with the tracker state (and
+    # EF residual, ledger, stacked partition view) restored from round 2's
+    # checkpoint — outputs bit-equal to the uninterrupted run
+    assert [r.round for r in res_b.metrics.rounds] == [3, 4]
+    _assert_trees_equal(res_a.trainable, res_b.trainable)
+    for ra, rb in zip(res_a.metrics.rounds[3:], res_b.metrics.rounds):
+        assert ra.mask == rb.mask
+        assert ra.reputation_state == rb.reputation_state
+        assert ra.reputation_trust == rb.reputation_trust
+        assert ra.auth == rb.auth
+    assert (res_a.metrics.reputation["final_trust"]
+            == res_b.metrics.reputation["final_trust"])
+    # the checkpoint genuinely carries the tracker arrays
+    from bcfl_tpu.checkpoint import restore_latest
+
+    _, state, _ = restore_latest(str(tmp_path / "a"))
+    for key in ("rep_trust", "rep_state", "rep_timer"):
+        assert state.get(key) is not None, key

@@ -176,6 +176,24 @@ class FedConfig:
     # contract (what the simulated clock does and does not model).
     sync: str = "sync"
     num_clients: int = 4
+    # --- cohort-batched client scale-out (SCALING.md "Cohort mode") ---
+    # registry_size > 0 turns on client sampling: the run simulates a
+    # registry of this many clients (data-partition identity, PRNG streams,
+    # fault schedules, reputation and error-feedback state are all keyed by
+    # registry id — host arrays sized by the registry), while each round a
+    # seeded sampler draws only `sample_clients` of them onto the stacked
+    # mesh axis. Device/HBM cost is bounded by the cohort, not the registry;
+    # per-round wall scales with the sampled cohort (sublinear in registry
+    # size). 0 = off (every client is a mesh slot every round — the
+    # pre-cohort behaviour, unchanged).
+    registry_size: int = 0
+    # per-round sampled cohort size (the stacked client axis width when
+    # sampling); 0 = fall back to num_clients. Must be <= registry_size.
+    sample_clients: int = 0
+    # clients stacked per device (the vmapped axis per mesh shard): > 0 pins
+    # the mesh to exactly sample_clients/cohort_size devices instead of the
+    # largest-divisor default. Must divide the sampled cohort size.
+    cohort_size: int = 0
     num_rounds: int = 2
     local_epochs: int = 1  # reference: 1 epoch per round (server_IID_IMDB.py:172)
     max_local_batches: Optional[int] = None  # cap scan length (static shape)
@@ -355,6 +373,79 @@ class FedConfig:
                 "tp > 1 tensor-shards the FROZEN base and keeps per-client "
                 "LoRA adapters; set lora_rank > 0 (full fine-tune is 1-D "
                 "clients-only)")
+        if self.async_buffer < 0:
+            raise ValueError(
+                f"async_buffer must be >= 0, got {self.async_buffer}")
+        if self.async_buffer > self.num_clients:
+            # an oversized buffer can never fill: K arrivals would be waited
+            # on forever while only num_clients exist — fail at config time
+            # instead of silently degenerating
+            raise ValueError(
+                f"async_buffer {self.async_buffer} > num_clients "
+                f"{self.num_clients}: the buffer could never fill (use 0 "
+                "for 'aggregate when everyone arrived')")
+        # --- cohort-mode capability table (SCALING.md "Cohort mode") ---
+        for field in ("registry_size", "sample_clients", "cohort_size"):
+            if getattr(self, field) < 0:
+                raise ValueError(
+                    f"{field} must be >= 0, got {getattr(self, field)}")
+        if self.registry_size == 0 and (self.sample_clients
+                                        or self.cohort_size):
+            raise ValueError(
+                "sample_clients/cohort_size have no effect without "
+                "registry_size > 0 (they shape the sampled cohort of a "
+                "client registry) — the same fail-loudly stance as the "
+                "codec sub-flags")
+        if self.registry_size > 0:
+            active = self.sample_clients or self.num_clients
+            if active > self.registry_size:
+                raise ValueError(
+                    f"sampled cohort {active} > registry_size "
+                    f"{self.registry_size}: cannot draw without replacement")
+            if self.cohort_size and active % self.cohort_size:
+                raise ValueError(
+                    f"cohort_size {self.cohort_size} must divide the "
+                    f"sampled cohort size {active} (it is the per-device "
+                    "stack of the cohort mesh)")
+            if self.cohort_size and self.pod:
+                # the pin truncates the device list to exactly
+                # cohort/cohort_size shards; on a multi-host pod that can
+                # exclude another process's addressable devices, which
+                # fails at first dispatch with an opaque device-assignment
+                # error — reject here instead
+                raise ValueError(
+                    "cohort_size is a single-host per-device-stack pin and "
+                    "does not compose with pod=True (truncating the "
+                    "hosts-major pod device list would strand other "
+                    "processes' devices); leave cohort_size=0 and let "
+                    "client_mesh lay the cohort over the full pod")
+            # declared capability table: what composes with sampling today.
+            # Aggregators (incl. robust rules), compression, ledger auth,
+            # reputation, and the dropout/straggler/corrupt/churn/flaky
+            # chaos lanes all compose (ids are registry ids). The paths
+            # below hold per-client state the registry cannot carry — they
+            # are rejected loudly rather than silently resampling it away.
+            if self.mode != "server":
+                raise ValueError(
+                    "registry sampling requires mode='server': serverless "
+                    "peers carry persistent per-client params, which a "
+                    "registry >> cohort cannot keep resident (the stacked "
+                    "tree IS the peer state)")
+            if self.sync != "sync":
+                raise ValueError(
+                    "registry sampling is not implemented for sync='async': "
+                    "the simulated network clock tracks per-client "
+                    "completion/staleness for a FIXED client set, and a "
+                    "per-round cohort would redefine that state each round")
+            if self.faithful:
+                raise ValueError(
+                    "registry sampling is not implemented for faithful "
+                    "(host-sequential) mode")
+            if self.faults.partitions:
+                raise ValueError(
+                    "chaos partition does not compose with registry "
+                    "sampling: components are defined over the full client "
+                    "set, and a per-round cohort would dissolve them")
 
     @property
     def resolved_prng_impl(self) -> Optional[str]:

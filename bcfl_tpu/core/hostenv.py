@@ -5,6 +5,15 @@ from __future__ import annotations
 import os
 
 
+def _jaxlib_version() -> tuple:
+    try:
+        import jaxlib  # does NOT initialize the backend
+
+        return tuple(int(x) for x in jaxlib.__version__.split(".")[:2])
+    except Exception:
+        return (0, 0)
+
+
 def raise_cpu_collective_timeouts() -> None:
     """Raise XLA's CPU collective-rendezvous timeouts BEFORE backend init.
 
@@ -12,7 +21,15 @@ def raise_cpu_collective_timeouts() -> None:
     device thread lags >40s behind the others (rendezvous.cc terminate
     timeout) — easily hit on a shared/loaded 1-core host where 8 device
     threads compete through a multi-round scan. No-op if the caller already
-    set the terminate flag (idempotent, and respects explicit tuning)."""
+    set the terminate flag (idempotent, and respects explicit tuning).
+
+    Version-gated: the ``--xla_cpu_collective_call_*`` flags only exist in
+    the XLA bundled with jaxlib >= 0.5, and older XLA FATALs the process on
+    any unknown XLA_FLAGS entry — injecting them on jaxlib 0.4.x kills the
+    run it was meant to protect (observed: every scripts/run_scaling.py
+    invocation on the 0.4.36 image died at backend init)."""
+    if _jaxlib_version() < (0, 5):
+        return
     flags = os.environ.get("XLA_FLAGS", "")
     if "collective_call_terminate" not in flags:
         os.environ["XLA_FLAGS"] = (

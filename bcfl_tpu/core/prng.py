@@ -11,13 +11,21 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def fold_round(key: jax.Array, round_idx: int) -> jax.Array:
     return jax.random.fold_in(key, round_idx)
 
 
-def client_round_keys(key: jax.Array, num_clients: int, round_idx: int) -> jax.Array:
-    """[num_clients, 2] stacked keys, one per client, distinct per round."""
+def client_round_keys(key: jax.Array, clients, round_idx: int) -> jax.Array:
+    """[num_clients, 2] stacked keys, one per client, distinct per round.
+
+    ``clients`` is a count (keys for ids ``0..n-1``) or an explicit id
+    vector — cohort mode (SCALING.md) passes the round's sampled REGISTRY
+    ids, so a client's stream depends only on ``(seed, id, round)``, never
+    on which cohort slot it landed in."""
     rk = fold_round(key, round_idx)
-    return jax.vmap(lambda c: jax.random.fold_in(rk, c))(jnp.arange(num_clients))
+    ids = (jnp.arange(clients) if isinstance(clients, (int, np.integer))
+           else jnp.asarray(np.asarray(clients), jnp.int32))
+    return jax.vmap(lambda c: jax.random.fold_in(rk, c))(ids)

@@ -65,13 +65,18 @@ def _gather_batches(ids, mask, labels, idx: np.ndarray, batch: int, steps: int):
 def client_batches(
     cache: TokenCache,
     part: Partitioner,
-    num_clients: int,
+    num_clients,
     round_idx: int,
     batch_size: int,
     max_batches: Optional[int] = None,
     split: str = "train",
 ) -> Tuple[dict, np.ndarray]:
     """Build the round's stacked per-client batches.
+
+    ``num_clients`` is a count (clients ``0..n-1``, the classic layout) or
+    an explicit client-id vector — cohort mode (SCALING.md) passes the
+    round's sampled REGISTRY ids, so each stacked slot carries that
+    registry client's own data partition.
 
     Returns ``(batch_tree, num_examples)`` where ``batch_tree`` leaves are
     ``[num_clients, steps, batch, ...]`` numpy arrays (``ids``, ``mask``,
@@ -84,10 +89,14 @@ def client_batches(
     else:
         ids, mask, labels = cache.test_ids, cache.test_mask, cache.test_labels
 
+    client_ids = (range(num_clients)
+                  if isinstance(num_clients, (int, np.integer))
+                  else np.asarray(num_clients).tolist())
     per_client_idx = []
-    for c in range(num_clients):
-        tr, te = part.train_test_indices(c, round_idx)
+    for c in client_ids:
+        tr, te = part.train_test_indices(int(c), round_idx)
         per_client_idx.append(tr if split == "train" else te)
+    num_clients = len(per_client_idx)
 
     sizes = [max(i.size, 1) for i in per_client_idx]
     steps = int(np.ceil(max(sizes) / batch_size))

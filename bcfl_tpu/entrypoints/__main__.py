@@ -40,6 +40,23 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=None)
     ap.add_argument("--lora-rank", type=int, default=None)
     ap.add_argument("--max-local-batches", type=int, default=None)
+    # cohort-batched client scale-out (SCALING.md "Cohort mode"): simulate
+    # a registry far larger than the mesh; a seeded sampler draws each
+    # round's active cohort onto the stacked axis
+    ap.add_argument("--registry-size", type=int, default=None,
+                    help="simulate a registry of N clients (host state "
+                         "only); each round a seeded sampler draws "
+                         "--sample-clients of them onto the mesh. Device "
+                         "memory and per-round cost are bounded by the "
+                         "cohort, not N. Requires mode=server")
+    ap.add_argument("--sample-clients", type=int, default=None,
+                    help="per-round sampled cohort size (the stacked "
+                         "client-axis width) under --registry-size; "
+                         "defaults to --clients")
+    ap.add_argument("--cohort-size", type=int, default=None,
+                    help="clients stacked (vmapped) per device: pins the "
+                         "mesh to sample_clients/cohort_size devices; must "
+                         "divide the sampled cohort size")
     ap.add_argument("--rounds-per-dispatch", type=int, default=None,
                     help="fuse up to N federated rounds into one XLA dispatch "
                          "(sync server FedAvg or parallel gossip; the ledger "
@@ -245,6 +262,8 @@ def main(argv=None):
         "seq_len": "seq_len", "batch_size": "batch_size",
         "lr": "learning_rate", "lora_rank": "lora_rank",
         "max_local_batches": "max_local_batches", "seed": "seed",
+        "registry_size": "registry_size", "sample_clients": "sample_clients",
+        "cohort_size": "cohort_size",
         "rounds_per_dispatch": "rounds_per_dispatch", "tp": "tp", "sp": "sp",
         "eval_every": "eval_every",
         "checkpoint_dir": "checkpoint_dir", "checkpoint_every": "checkpoint_every",

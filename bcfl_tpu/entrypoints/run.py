@@ -31,9 +31,14 @@ def run(cfg: FedConfig, resume: bool = False, verbose: bool = True,
 
 
 def _header(cfg: FedConfig) -> list:
+    clients = f"clients={cfg.num_clients}"
+    if cfg.registry_size:
+        # cohort mode (SCALING.md): the stacked axis is the sampled cohort
+        clients = (f"registry={cfg.registry_size} "
+                   f"cohort={cfg.sample_clients or cfg.num_clients}/round")
     return [
         f"== {cfg.name} ==",
-        f"mode={cfg.mode} sync={cfg.sync} clients={cfg.num_clients} "
+        f"mode={cfg.mode} sync={cfg.sync} {clients} "
         f"rounds={cfg.num_rounds} model={cfg.model} dataset={cfg.dataset}",
     ]
 

@@ -309,6 +309,14 @@ def build_programs(
     # (halves peak HBM for the round-chained engine); leave False if you reuse
     # the input tree afterwards.
     donate: bool = False,
+    # hierarchical=True compiles the explicit two-level device -> global
+    # aggregation (gspmd.hierarchical_weighted_mean) into every mean
+    # aggregation point — cohort mode's within-cohort-then-cross-device
+    # reduction (SCALING.md). Only meaningful for aggregator='mean' (the
+    # robust order statistics are global by definition) and only the gspmd
+    # impl compiles it; normalized away otherwise so equal program sets
+    # share one cache entry.
+    hierarchical: bool = False,
     # Two numerically-identical implementations of the same programs:
     #   "gspmd"     (default) — global stacked-client arrays under plain jit
     #               with sharding annotations; XLA's SPMD partitioner inserts
@@ -329,6 +337,11 @@ def build_programs(
         # makes that identity observable: build_programs(compression=none)
         # IS build_programs() (tests/test_compression.py pins it)
         compression = None
+    # same normalization for the hierarchical flag: it only changes the
+    # 'mean' aggregation body, so a hierarchical trimmed_mean/median/krum
+    # build IS the plain build — sharing the entry keeps cohort-mode robust
+    # runs on the exact programs the chaos matrix already compiled
+    hierarchical = bool(hierarchical) and aggregator == "mean"
     # Program memoization: flax modules and jax Meshes hash/compare by VALUE
     # (module config dataclasses, mesh devices + axis names), so two engines
     # over equal configs get the SAME jitted program objects — and with them
@@ -342,7 +355,7 @@ def build_programs(
         # mesh field, including any added later that changes program layout
         key = (model, mesh, optimizer, learning_rate, max_grad_norm,
                gossip_alpha, gossip_steps, task, aggregator, aggregator_trim,
-               prng_impl, donate, impl, compression)
+               prng_impl, donate, impl, compression, hierarchical)
         hash(key)
     except TypeError:
         key = None
@@ -355,7 +368,8 @@ def build_programs(
         max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
         gossip_steps=gossip_steps, donate=donate, task=task,
         aggregator=aggregator, aggregator_trim=aggregator_trim,
-        prng_impl=prng_impl, compression=compression, impl=impl)
+        prng_impl=prng_impl, compression=compression,
+        hierarchical=hierarchical, impl=impl)
     if key is not None:
         while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
             # FIFO eviction bounds the compiled-executable footprint over a
@@ -390,6 +404,7 @@ def _build_programs_dispatch(
     prng_impl: Optional[str],
     compression: Optional[CompressionConfig],
     donate: bool,
+    hierarchical: bool,
     impl: str,
 ) -> FedPrograms:
     if impl == "gspmd":
@@ -398,9 +413,17 @@ def _build_programs_dispatch(
             max_grad_norm=max_grad_norm, gossip_alpha=gossip_alpha,
             gossip_steps=gossip_steps, donate=donate, task=task,
             aggregator=aggregator, aggregator_trim=aggregator_trim,
-            prng_impl=prng_impl, compression=compression)
+            prng_impl=prng_impl, compression=compression,
+            hierarchical=hierarchical)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
+    if hierarchical:
+        # the explicit two-level reduction is global-array math over the
+        # full stacked client dim — the manual-SPMD twin would need its own
+        # psum-within-psum form; only the GSPMD programs compile it
+        raise ValueError(
+            "hierarchical aggregation (cohort mode) requires impl='gspmd' "
+            "(unset BCFL_FED_IMPL or set it to 'gspmd')")
     if compression is not None and compression.enabled:
         # same gap class as the robust aggregators below (both documented in
         # ROBUSTNESS.md §5): the codecs are global-array math over the full
@@ -714,6 +737,7 @@ def _build_programs_gspmd(
     aggregator_trim: float = 0.2,
     prng_impl: Optional[str] = None,
     compression: Optional[CompressionConfig] = None,
+    hierarchical: bool = False,
 ) -> FedPrograms:
     """GSPMD twin of the shard_map builder: identical program signatures and
     semantics (global stacked-client arrays in, global arrays out), but the
@@ -742,7 +766,13 @@ def _build_programs_gspmd(
     uncompressed build."""
     comp = (compression
             if compression is not None and compression.enabled else None)
-    agg = gspmd.make_aggregator(aggregator, aggregator_trim)
+    # hierarchical (cohort mode): every 'mean' aggregation point — server
+    # FedAvg, collapse, the serverless exact-mean — becomes the explicit
+    # within-device-stack then cross-device reduction; groups = the mesh's
+    # clients-axis extent, so each inner group IS one device's cohort slice
+    groups = int(mesh.mesh.shape[mesh.axis]) if hierarchical else 0
+    agg = gspmd.make_aggregator(aggregator, aggregator_trim,
+                                hierarchical_groups=groups)
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model, task)
     unstack = lambda r: _unstack_rng(r, prng_impl)  # noqa: E731

@@ -1,0 +1,138 @@
+"""Cohort-batched client scale-out: seeded registry sampling + per-registry
+host state (SCALING.md "Cohort mode").
+
+The engine's unit of execution has always been a stacked client axis — a
+``(num_clients, ...)`` leading dim vmapped per device and sharded over the
+mesh. What capped the simulator at tens of clients was the IDENTITY between
+that axis and the client population: every registered client occupied a mesh
+slot every round. Cohort mode splits the two:
+
+- a **registry** of ``registry_size`` clients exists only as host state
+  (data-partition identity, PRNG stream, fault schedules, reputation arrays,
+  error-feedback residuals — everything keyed by registry id),
+- each round a seeded :class:`ClientSampler` draws a ``cohort`` of
+  ``sample_clients`` registry ids, and ONLY that cohort occupies the stacked
+  axis: same compiled programs, same shapes, zero per-round retraces —
+  the cohort ids are runtime *values*, never trace-time shapes,
+- device/HBM cost is bounded by the cohort (``sample_clients``), not the
+  registry; per-round host cost is O(registry) only in trivially cheap
+  lanes (one RNG draw per fault lane, the reputation EWMA pass).
+
+Design constraints (the :mod:`bcfl_tpu.faults` contract):
+
+- **Deterministic.** The sampler is a pure function of
+  ``(seed, round)`` via ``np.random.default_rng`` — no sequential RNG
+  state, so a resumed run reproduces the remaining rounds' cohorts
+  bit-for-bit from the config seed alone (the checkpoint still records
+  registry/cohort sizes and refuses a mismatch: changing either changes
+  the cohort stream).
+- **Checkpointable.** :class:`EFRegistry` (the per-registry-client
+  error-feedback residual store compression carries across rounds)
+  round-trips through the engine checkpoint as a stacked tree + id vector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+# sampler RNG lane: the tuple seed (cfg.seed, _SAMPLER_LANE, round) keeps the
+# cohort draw on its own stream — enabling any fault lane (which draws from
+# (faults.seed, lane, round)) can never reshuffle which clients are sampled
+_SAMPLER_LANE = 77_003
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientSampler:
+    """Seeded per-round cohort draw over a client registry.
+
+    ``cohort_ids(rnd)`` is a pure function: uniform without replacement,
+    sorted ascending (a stable presentation order for records/ledger
+    entries; the stacked-slot order carries no semantics — aggregation is
+    permutation-invariant up to FP summation order, which the sort pins).
+    """
+
+    seed: int
+    registry_size: int
+    cohort: int
+
+    def __post_init__(self):
+        if not 1 <= self.cohort <= self.registry_size:
+            raise ValueError(
+                f"cohort {self.cohort} must be in [1, registry_size="
+                f"{self.registry_size}]")
+
+    def cohort_ids(self, round_idx: int) -> np.ndarray:
+        """[cohort] int64 registry ids sampled for ``round_idx``."""
+        rng = np.random.default_rng((self.seed, _SAMPLER_LANE, round_idx))
+        ids = rng.choice(self.registry_size, size=self.cohort, replace=False)
+        return np.sort(ids).astype(np.int64)
+
+
+class EFRegistry:
+    """Host-side per-registry-client error-feedback residual store.
+
+    The compiled codec programs carry a stacked ``[cohort, ...]`` f32
+    residual; with sampling on, that buffer belongs to a DIFFERENT set of
+    clients each round, so the engine gathers the cohort's residuals from here
+    before the round and scatters the updated rows back after it. Unseen
+    clients read as zeros (the fresh-residual semantics of ``ef_init``), so
+    the store grows O(unique sampled clients x params) on the host — the
+    device never holds more than the cohort's rows.
+    """
+
+    def __init__(self, template_tree):
+        # per-client zero template, shaped like one client's residual row
+        self._zero = jax.tree.map(
+            lambda x: np.zeros(x.shape, np.float32), template_tree)
+        self._store: Dict[int, object] = {}
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def gather(self, ids: np.ndarray):
+        """Stacked host tree ``[len(ids), ...]`` of the ids' residuals."""
+        rows = [self._store.get(int(i), self._zero) for i in ids]
+        return jax.tree.map(lambda *xs: np.stack(xs), *rows)
+
+    def scatter(self, ids: np.ndarray, host_stacked) -> None:
+        """Write the round's updated residual rows back by registry id.
+
+        Rows are COPIED out of the stacked buffer: ``x[pos]`` is a numpy
+        view whose base is the whole ``[C, ...]`` leaf, and storing views
+        would keep every round's full cohort buffer alive for as long as
+        any one of its rows is some client's current residual."""
+        for pos, i in enumerate(ids):
+            self._store[int(i)] = jax.tree.map(
+                lambda x: np.array(x[pos], copy=True), host_stacked)
+
+    # ------------------------------------------------------------ checkpoint
+
+    def checkpoint_state(self) -> Dict[str, object]:
+        """``ef_ids`` ([K] int64) + ``ef_registry`` (stacked tree) for the
+        engine checkpoint; empty dict when nothing has been scattered yet
+        (restore treats absence as an empty store)."""
+        if not self._store:
+            return {}
+        ids = np.asarray(sorted(self._store), np.int64)
+        return {"ef_ids": ids, "ef_registry": self.gather(ids)}
+
+    def restore(self, state: Dict) -> None:
+        self._store.clear()
+        ids = state.get("ef_ids")
+        if ids is None:
+            return
+        self.scatter(np.asarray(ids, np.int64).reshape(-1),
+                     state["ef_registry"])
+
+
+def cohort_view(arr: Optional[np.ndarray],
+                ids: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """Slice a registry-sized host array down to the round's cohort rows
+    (identity when sampling is off — ``ids is None`` — or ``arr`` is None)."""
+    if arr is None or ids is None:
+        return arr
+    return np.asarray(arr)[ids]

@@ -43,6 +43,16 @@ client view, robust reconciliation on heal); **churn** composes permanent
 leave / late join into the mask; **flaky** bursts ride the corruption
 transport stage. All of it is host-side mask/weight arithmetic feeding the
 already-compiled programs — no per-round retraces.
+
+Cohort-batched scale-out (SCALING.md "Cohort mode"): with
+``cfg.registry_size > 0`` the run simulates a REGISTRY of clients far larger
+than the mesh — per-client identity (data partition, PRNG stream, fault
+schedule, reputation, EF residuals) is keyed by registry id in host state,
+and each round a seeded sampler (:mod:`bcfl_tpu.fed.cohort`) draws
+``sample_clients`` of them onto the stacked axis. The compiled programs and
+their shapes never change (cohort ids are runtime values), aggregation runs
+the explicit hierarchical within-device-stack -> cross-device reduction, and
+device memory is bounded by the cohort, not the registry.
 """
 
 from __future__ import annotations
@@ -72,6 +82,7 @@ from bcfl_tpu.data import (
 from bcfl_tpu.data.pipeline import central_eval_batches
 from bcfl_tpu.faults import FaultInjector, SimulatedCrash
 from bcfl_tpu.fed.client_step import FedPrograms, build_programs, _merge
+from bcfl_tpu.fed.cohort import ClientSampler, EFRegistry, cohort_view
 from bcfl_tpu.ledger import Ledger
 from bcfl_tpu.ledger import fingerprint as fp_lib
 from bcfl_tpu.metrics import (
@@ -150,13 +161,37 @@ class FedEngine:
                 "tamper_hook/fused_tamper are deprecated shims — schedule "
                 "corruption via FedConfig.faults (bcfl_tpu.faults.FaultPlan)",
                 DeprecationWarning, stacklevel=2)
+        # --- cohort-batched scale-out (SCALING.md "Cohort mode") ---
+        # self.C = the stacked client-axis width (the per-round cohort);
+        # self.R = the client registry size. Sampling off: R == C ==
+        # num_clients and every per-round id is an identity — the classic
+        # layout, bit-identical to the pre-cohort engine. Sampling on:
+        # registry-sized HOST arrays (faults, reputation, EF residuals)
+        # carry per-client identity; only the sampled cohort's rows ever
+        # reach the mesh.
+        self.sampling = cfg.registry_size > 0
+        self.C = ((cfg.sample_clients or cfg.num_clients) if self.sampling
+                  else cfg.num_clients)
+        self.R = cfg.registry_size if self.sampling else cfg.num_clients
+        self.sampler = (ClientSampler(cfg.seed, self.R, self.C)
+                        if self.sampling else None)
+        self._cohort_cache = (-1, None)
+        if self.sampling and (tamper_hook is not None
+                              or fused_tamper is not None):
+            raise ValueError(
+                "the legacy tamper_hook/fused_tamper shims are positional "
+                "over a fixed client set; with registry sampling schedule "
+                "corruption via FedConfig.faults (its schedules are keyed "
+                "by registry id)")
         self.faults = FaultInjector(
-            cfg.faults, cfg.num_clients,
+            cfg.faults, self.R,
             host_tamper=tamper_hook, fused_tamper=fused_tamper)
         # peer-lifecycle reputation (bcfl_tpu.reputation): host-side state
         # machine whose gate multiplier folds into each round's mask —
-        # None when disabled; state rides the checkpoint
-        self.reputation = (ReputationTracker(cfg.reputation, cfg.num_clients)
+        # None when disabled; state rides the checkpoint. Sized by the
+        # REGISTRY: a flaky peer keeps its record whether or not this
+        # round's sampler drew it.
+        self.reputation = (ReputationTracker(cfg.reputation, self.R)
                            if cfg.reputation.enabled else None)
         self.root_key = jax.random.key(cfg.seed,
                                        impl=cfg.resolved_prng_impl)
@@ -186,7 +221,27 @@ class FedEngine:
         # tp>1 makes the mesh 2-D (clients, tp) and megatron-shards the
         # frozen base; sp>1 makes it (clients, seq) and rides ring attention
         devices = pod_devices() if cfg.pod else None
-        self.mesh = client_mesh(cfg.num_clients, devices=devices,
+        if self.sampling and cfg.cohort_size:
+            # pin the per-device stack: exactly C/cohort_size CLIENT shards
+            # (config validated the divisibility), each vmapping a
+            # cohort_size-client slab. With an inner tp/sp axis the mesh
+            # reserves `inner` devices per client shard, so the device
+            # budget scales by it — without this, client_mesh would quietly
+            # fold the shortfall back into a bigger per-device stack,
+            # breaking the documented pin.
+            devices = list(devices if devices is not None
+                           else jax.devices())
+            inner = max(cfg.tp, cfg.sp)
+            need = (self.C // cfg.cohort_size) * inner
+            if need > len(devices):
+                raise ValueError(
+                    f"cohort_size {cfg.cohort_size} needs {need} devices "
+                    f"for a {self.C}-client cohort"
+                    + (f" x {inner} inner (tp/sp) shards" if inner > 1
+                       else "")
+                    + f", have {len(devices)}")
+            devices = devices[:need]
+        self.mesh = client_mesh(self.C, devices=devices,
                                 tp=cfg.tp, sp=cfg.sp)
 
         # --- model ---
@@ -287,6 +342,11 @@ class FedEngine:
             prng_impl=cfg.resolved_prng_impl,
             compression=cfg.compression,
             donate=cfg.donate,
+            # cohort mode compiles the explicit hierarchical (within-device
+            # stack, then cross-device) reduction into every mean
+            # aggregation point (SCALING.md); normalized away for robust
+            # aggregators, whose order statistics stay global
+            hierarchical=self.sampling,
         )
         # communication compression (COMPRESSION.md): None when disabled.
         # The error-feedback residual (stacked [C, ...] f32) is engine round
@@ -294,6 +354,7 @@ class FedEngine:
         # must reproduce compressed runs bit-for-bit too.
         self._comp = cfg.compression if cfg.compression.enabled else None
         self._ef = None
+        self._ef_reg = None  # cohort-mode per-registry EF store, set below
         if self._comp is not None and tamper_hook is not None:
             # the legacy host-tamper shim byte-hashes FULL host trees; with
             # compression the wire carries payloads, so the two transport
@@ -333,16 +394,22 @@ class FedEngine:
         self.trainable0 = self.mesh.replicate(self.trainable0)
         if self.frozen is not None and cfg.tp == 1:
             self.frozen = self.mesh.replicate(self.frozen)
+        if self.sampling and self._comp is not None:
+            # cohort-mode error-feedback store: residuals live per REGISTRY
+            # client on the host; each round the sampled cohort's rows are
+            # gathered onto the device and scattered back after (fed.cohort)
+            self._ef_reg = EFRegistry(self.trainable0)
 
-        # --- topology graph ---
-        if cfg.topology.bandwidth == "reference" and cfg.num_clients == 10:
+        # --- topology graph (positional over the round's stacked slots:
+        # in cohort mode the network model applies to whoever is sampled) ---
+        if cfg.topology.bandwidth == "reference" and self.C == 10:
             self.graph: LatencyGraph = reference_graph()
         else:
             self.graph = random_graph(
-                cfg.num_clients, cfg.topology.bw_low, cfg.topology.bw_high,
+                self.C, cfg.topology.bw_low, cfg.topology.bw_high,
                 seed=cfg.seed,
             )
-        self.info_source = info_source % cfg.num_clients
+        self.info_source = info_source % self.C
 
         self.ledger = Ledger(cfg.ledger.use_native) if cfg.ledger.enabled else None
         # bytes-on-wire accounting (COMPRESSION.md): what ONE client ships
@@ -360,7 +427,7 @@ class FedEngine:
             # ledger entries digest the COMPRESSED payload: precompute its
             # structure digest from an eval_shape of the encoder (no device
             # work), so split-phase and fused rounds bind identical digests
-            C = cfg.num_clients
+            C = self.C
 
             def _payload_shape(t):
                 stacked = jax.tree.map(
@@ -377,13 +444,50 @@ class FedEngine:
 
     # ------------------------------------------------------------------ utils
 
+    def _cohort_ids(self, rnd: int) -> Optional[np.ndarray]:
+        """The round's sampled registry ids ([C] int64), or None when
+        sampling is off (stacked slot == client id). Cached per round —
+        the sampler is a pure function of (seed, round), so the cache only
+        saves the re-draw, never changes the value."""
+        if self.sampler is None:
+            return None
+        if self._cohort_cache[0] != rnd:
+            self._cohort_cache = (rnd, self.sampler.cohort_ids(rnd))
+        return self._cohort_cache[1]
+
+    def _client_id(self, rnd: int, pos: int) -> int:
+        """Registry client id occupying stacked slot ``pos`` this round."""
+        ids = self._cohort_ids(rnd)
+        return int(ids[pos]) if ids is not None else pos
+
+    def _transport_scales(self, rnd: int) -> Optional[np.ndarray]:
+        """The round's transport-corruption scales for the STACKED slots:
+        the plan draws per registry client; cohort mode slices the sampled
+        rows (and an all-clean slice collapses to None, keeping the clean
+        fast path). The one call-site rule of the FaultInjector still
+        holds — every consumer (round bodies, reputation evidence) goes
+        through here, so 'is corruption on the wire this round' can never
+        disagree between them."""
+        row = self.faults.transport_scales(rnd)
+        ids = self._cohort_ids(rnd)
+        if row is None or ids is None:
+            return row
+        row = row[ids]
+        return row if row.any() else None
+
     def _round_batches(self, rnd: int):
         cfg = self.cfg
-        static = not (cfg.partition.kind == "iid" and cfg.partition.resample_each_round)
+        # cohort mode: batches depend on WHO was sampled, so the
+        # round-static cache only applies with sampling off
+        static = (not (cfg.partition.kind == "iid"
+                       and cfg.partition.resample_each_round)
+                  and not self.sampling)
         if static and self._static_batches is not None:
             return self._static_batches
+        ids = self._cohort_ids(rnd)
         tree, n_ex = client_batches(
-            self.cache, self.partitioner, cfg.num_clients, rnd, cfg.batch_size,
+            self.cache, self.partitioner,
+            ids if ids is not None else self.C, rnd, cfg.batch_size,
             max_batches=cfg.max_local_batches,
         )
         out = (self.mesh.shard_clients(jax.tree.map(jnp.asarray, tree)),
@@ -394,15 +498,21 @@ class FedEngine:
 
     def _test_batches(self, rnd: int):
         cfg = self.cfg
+        ids = self._cohort_ids(rnd)
         tree, _ = client_batches(
-            self.cache, self.partitioner, cfg.num_clients, rnd, cfg.batch_size,
+            self.cache, self.partitioner,
+            ids if ids is not None else self.C, rnd, cfg.batch_size,
             max_batches=cfg.max_local_batches, split="test",
         )
         return self.mesh.shard_clients(jax.tree.map(jnp.asarray, tree))
 
     def _rngs(self, rnd: int):
+        # keyed by REGISTRY id in cohort mode: a client's dropout/codec
+        # stream depends on (seed, id, round), never on its cohort slot
+        ids = self._cohort_ids(rnd)
         keys = client_round_keys(
-            jax.random.fold_in(self.root_key, 4), self.cfg.num_clients, rnd)
+            jax.random.fold_in(self.root_key, 4),
+            ids if ids is not None else self.C, rnd)
         return self.mesh.shard_clients(jax.random.key_data(keys))
 
     def _participation(self, rnd: int, components=None) -> Dict:
@@ -448,12 +558,14 @@ class FedEngine:
     def _ledger_authenticate(self, rnd: int, host) -> np.ndarray:
         """Authenticate what 'arrived' against the already-committed chain
         (tamper_hook simulates in-flight modification). Returns 0/1 auth mask."""
-        C = self.cfg.num_clients
+        C = self.C
         tamper = self.faults.host_tamper
         shipped = tamper(rnd, host) if tamper else host
         auth = np.ones((C,), np.float32)
         for c in range(C):
-            ok = self.ledger.authenticate(rnd, c, jax.tree.map(lambda x: x[c], shipped))
+            ok = self.ledger.authenticate(
+                rnd, self._client_id(rnd, c),
+                jax.tree.map(lambda x: x[c], shipped))
             auth[c] = 1.0 if ok else 0.0
         return auth
 
@@ -473,7 +585,7 @@ class FedEngine:
                     "payload struct digest requested without compression")
             tmpl = self.trainable0
             if kind == "stacked":
-                C = self.cfg.num_clients
+                C = self.C
                 tmpl = jax.eval_shape(
                     lambda t: jax.tree.map(
                         lambda x: jnp.broadcast_to(x[None], (C,) + x.shape),
@@ -485,10 +597,13 @@ class FedEngine:
                                    self.cfg.ledger.use_native)
 
     def _ledger_commit_rows(self, rnd: int, kind: str, fps) -> None:
-        """Chain one entry per client for the given fingerprint rows [C, K]."""
-        for c in range(self.cfg.num_clients):
+        """Chain one entry per client for the given fingerprint rows [C, K].
+        Entries are keyed by REGISTRY client id (slot id when sampling is
+        off), so a client's chain history survives cohort reshuffles."""
+        for c in range(self.C):
             self.ledger.append_digest(
-                rnd, c, self._entry_digest(kind, fps[c]),
+                rnd, self._client_id(rnd, c),
+                self._entry_digest(kind, fps[c]),
                 self._client_payload_bytes)
 
     def _ledger_auth_rows(self, rnd: int, kind: str, fps) -> np.ndarray:
@@ -497,9 +612,10 @@ class FedEngine:
         faithful ledger paths so the digest binding cannot diverge."""
         return np.asarray([
             1.0 if self.ledger.authenticate_digest(
-                rnd, c, self._entry_digest(kind, fps[c]))
+                rnd, self._client_id(rnd, c),
+                self._entry_digest(kind, fps[c]))
             else 0.0
-            for c in range(self.cfg.num_clients)], np.float32)
+            for c in range(self.C)], np.float32)
 
     def _ledger_verify(self, rnd: int, stacked, sent=None,
                        kind: str = "stacked") -> np.ndarray:
@@ -523,7 +639,7 @@ class FedEngine:
         BERT-base x 10 clients over the r03 host path). A ``tamper_hook``
         simulates in-flight modification of HOST trees, so that path keeps
         the faithful full byte-hash flow."""
-        C = self.cfg.num_clients
+        C = self.C
         # dispatch is async: without this, the TRAINING compute of the
         # just-dispatched client_updates/local_updates program completes
         # inside this phase's first blocking transfer and gets billed to
@@ -633,7 +749,7 @@ class FedEngine:
         transport corruption/flaky bursts. Everything here is pre-compiled
         programs fed runtime masks/weights: zero per-round retraces."""
         cfg = self.cfg
-        C = cfg.num_clients
+        C = self.C
         batches, n_ex = self._round_batches(rnd)
         rngs = self._rngs(rnd)
         if stacked is None:
@@ -644,7 +760,7 @@ class FedEngine:
         stacked, stats = self.progs.local_updates(
             stacked, self.frozen, batches, rngs)
         rec = self._stats_to_rec(rnd, stats)
-        scales = self.faults.transport_scales(rnd)
+        scales = self._transport_scales(rnd)
         auth = None
         if self._comp is not None:
             _, recon, auth = self._compressed_exchange(
@@ -734,7 +850,7 @@ class FedEngine:
         and recorded round outputs, so the trajectory is deterministic and
         crash/resume-stable."""
         rcfg = self.cfg.reputation
-        C = self.cfg.num_clients
+        C = self.C
         fault = np.zeros((C,), np.float64)
         if rec.auth is not None:
             failed = (np.asarray(rec.auth, np.float64) == 0.0)
@@ -744,7 +860,7 @@ class FedEngine:
             flag[list(gate["anomalies"])] = 1.0
             fault = np.maximum(fault, rcfg.w_anomaly * flag)
         if rcfg.observe_injected:
-            scales = self.faults.transport_scales(rnd)  # deterministic redraw
+            scales = self._transport_scales(rnd)  # deterministic redraw
             if scales is not None:
                 hit = (np.asarray(scales, np.float64) != 0.0)
                 fault = np.maximum(fault, rcfg.w_corrupt * hit)
@@ -752,9 +868,28 @@ class FedEngine:
             stale = (np.asarray(rec.staleness, np.float64)
                      > rcfg.staleness_limit)
             fault = np.maximum(fault, rcfg.w_staleness * stale)
-        self.reputation.observe(fault)
-        rec.reputation_state = self.reputation.state_names()
-        rec.reputation_trust = [float(t) for t in self.reputation.trust]
+        ids = self._cohort_ids(rnd)
+        if ids is None:
+            self.reputation.observe(fault)
+            rec.reputation_state = self.reputation.state_names()
+            rec.reputation_trust = [float(t) for t in self.reputation.trust]
+            return
+        # cohort mode: scatter the cohort's evidence into the
+        # registry-sized tracker. Only sampled peers are 'active' — their
+        # EWMA and probation clocks advance; a non-sampled peer's trust
+        # must not drift on rounds it never participated in (quarantine
+        # sentences still tick: wall rounds pass either way). The record
+        # carries the cohort's post-round view, slot-aligned with
+        # mask/auth.
+        fault_r = np.zeros((self.R,), np.float64)
+        fault_r[ids] = fault
+        active = np.zeros((self.R,), bool)
+        active[ids] = True
+        self.reputation.observe(fault_r, active=active)
+        names = self.reputation.state_names()
+        rec.reputation_state = [names[int(i)] for i in ids]
+        rec.reputation_trust = [float(self.reputation.trust[int(i)])
+                                for i in ids]
 
     # ------------------------------------------------------------------- run
 
@@ -819,6 +954,29 @@ class FedEngine:
                         f"checkpoint was written with seed {int(ck_seed)} but "
                         f"config has seed {cfg.seed}: resuming would break the "
                         "per-(client, round) RNG stream")
+                # cohort identity: the sampler is a pure function of
+                # (seed, registry_size, sample_clients, round) — the seed
+                # check above plus these two pin the remaining rounds'
+                # cohorts bit-for-bit; a change would silently re-deal
+                # every future cohort
+                ck_reg = state.get("registry_size")
+                want_sc = self.C if self.sampling else 0
+                if ck_reg is not None:
+                    ck_sc = int(state.get("sample_clients") or 0)
+                    if (int(ck_reg) != int(cfg.registry_size)
+                            or ck_sc != want_sc):
+                        raise ValueError(
+                            "checkpoint was written with registry_size="
+                            f"{int(ck_reg)}/sample_clients={ck_sc} but this "
+                            f"run has {cfg.registry_size}/{want_sc}: "
+                            "resuming would change the per-round cohort "
+                            "stream")
+                elif self.sampling:
+                    raise ValueError(
+                        "checkpoint predates cohort mode (no registry_size "
+                        "recorded) but this run samples a registry: "
+                        "resuming would change every remaining round's "
+                        "cohort")
                 # checkpoints written under a different param_dtype must not
                 # silently override the configured one on resume
                 pd = jnp.dtype(cfg.param_dtype)
@@ -839,6 +997,11 @@ class FedEngine:
                     self._ef = self.mesh.shard_clients(jax.tree.map(
                         lambda x: jnp.asarray(x, jnp.float32),
                         state["ef_residual"]))
+                if self._ef_reg is not None:
+                    # cohort mode carries residuals per REGISTRY client
+                    # instead (ef_ids + ef_registry); the round loop
+                    # re-gathers each cohort's rows from the restored store
+                    self._ef_reg.restore(state)
                 # replicate: a resumed tree left on the default device would
                 # re-trigger the round-2 recompile (tests/test_recompile.py)
                 trainable = self.mesh.replicate(_cast(state["trainable"]))
@@ -865,9 +1028,12 @@ class FedEngine:
                 "program. Build a fresh FedEngine (or resume from a "
                 "checkpoint, or set donate=False) to run again.")
 
-        if self._comp is not None and self._ef is None:
+        if (self._comp is not None and self._ef is None
+                and self._ef_reg is None):
             # fresh error-feedback state (zeros): round 1's encode sees the
-            # pure delta, later rounds re-inject what compression dropped
+            # pure delta, later rounds re-inject what compression dropped.
+            # Cohort mode skips this — each round gathers its cohort's
+            # residual rows from the registry store instead.
             self._ef = self.progs.ef_init(trainable)
 
         if cfg.mode == "serverless" and not cfg.faithful and stacked is None:
@@ -926,28 +1092,34 @@ class FedEngine:
                     "tampering")
 
             t0 = time.time()
+            ids = self._cohort_ids(rnd)
             comps = self.faults.partition_components(rnd)
             with clock.phase("control_plane"):
                 gate = self._participation(rnd, comps)
                 mask = gate["mask"].astype(np.float32)
                 # chaos dropout composes with the anomaly gate exactly like
                 # a second filter: the mesh never reshapes, dropped clients
-                # carry weight 0 for the round
-                keep = self.faults.dropout_keep(rnd)
+                # carry weight 0 for the round. All chaos lanes draw per
+                # REGISTRY client; cohort_view slices the sampled rows
+                # (identity when sampling is off).
+                keep = cohort_view(self.faults.dropout_keep(rnd), ids)
                 dropped = None
                 if keep is not None:
-                    dropped = [c for c in range(cfg.num_clients)
-                               if keep[c] == 0.0]
+                    # SLOT indices, like every other per-client index list
+                    # on the record (anomalies, mask positions); cohort
+                    # mode recovers registry identity via rec.cohort[slot]
+                    dropped = [c for c in range(self.C) if keep[c] == 0.0]
                     mask = mask * keep
                 # churn: permanently-departed / not-yet-joined clients carry
                 # weight 0 — the monotone twin of dropout
-                alive = self.faults.churn_alive(rnd)
+                alive = cohort_view(self.faults.churn_alive(rnd), ids)
                 if alive is not None:
                     mask = mask * alive
                 # reputation gate: quarantined peers 0, probation peers a
-                # reduced vote weight (bcfl_tpu.reputation)
+                # reduced vote weight (bcfl_tpu.reputation; registry-sized,
+                # cohort-sliced)
                 if self.reputation is not None:
-                    mask = mask * self.reputation.gate()
+                    mask = mask * cohort_view(self.reputation.gate(), ids)
                 healed = False
                 if (comps is None and stacked is not None and rnd > 0
                         and self.faults.partition_components(rnd - 1)
@@ -959,7 +1131,15 @@ class FedEngine:
                         trainable, stacked, mask)
                     healed = True
 
-            delays = self.faults.straggler_delays(rnd)
+            delays = cohort_view(self.faults.straggler_delays(rnd), ids)
+            if delays is not None and not delays.any():
+                delays = None  # no sampled client straggles this round
+            if self._ef_reg is not None:
+                # gather the cohort's error-feedback residual rows from the
+                # per-registry store (zeros for never-sampled clients) —
+                # the compiled codec programs see the usual [C, ...] carry
+                self._ef = self.mesh.shard_clients(jax.tree.map(
+                    jnp.asarray, self._ef_reg.gather(ids)))
             with clock.phase("round_program"):
                 if comps is not None:
                     trainable, stacked, rec = self._partitioned_round(
@@ -975,8 +1155,15 @@ class FedEngine:
                 else:
                     stacked, trainable, rec = self._serverless_round(
                         rnd, stacked, trainable, mask)
+            if self._ef_reg is not None:
+                # scatter the updated residual rows back by registry id
+                # BEFORE eval/checkpoint, so the checkpointed store matches
+                # the uninterrupted run's at every boundary
+                self._ef_reg.scatter(ids, jax.device_get(self._ef))
 
             rec.mask = mask.tolist()
+            if ids is not None:
+                rec.cohort = ids.tolist()
             rec.anomalies = list(gate["anomalies"])
             rec.healed = healed
             if dropped is not None:
@@ -996,7 +1183,7 @@ class FedEngine:
                     c for c in comps if self.info_source in c))
             if alive is not None:
                 base = (restrict if restrict is not None
-                        else range(cfg.num_clients))
+                        else range(self.C))
                 restrict = [c for c in base
                             if alive[c] > 0 or c == self.info_source]
             sync_t, async_t = self.graph.info_passing_time(
@@ -1027,7 +1214,8 @@ class FedEngine:
         metrics.phases = clock.summary()
         # run-level bytes-on-wire accounting (COMPRESSION.md): per-round
         # totals are on every RoundRecord; this is the headline rollup
-        C = cfg.num_clients
+        # (per-cohort in sampling mode: only sampled clients ship updates)
+        C = self.C
         metrics.comms = {
             "compress": cfg.compression.kind,
             "bytes_raw_per_round": float(self._raw_bytes_per_client * C),
@@ -1081,9 +1269,17 @@ class FedEngine:
             "trainable": jax.device_get(trainable),
             "stacked": jax.device_get(stacked) if stacked is not None else None,
             # compression error-feedback residual (None when compression is
-            # off); required for bit-identical compressed crash/resume
+            # off); required for bit-identical compressed crash/resume.
+            # Cohort mode stores the per-REGISTRY store (ef_ids/ef_registry
+            # below) instead — the stacked device buffer is just the last
+            # cohort's gathered view.
             "ef_residual": (jax.device_get(self._ef)
-                            if self._ef is not None else None),
+                            if self._ef is not None and self._ef_reg is None
+                            else None),
+            # cohort identity: with cfg.seed these pin the sampler's entire
+            # cohort stream; resume refuses a change (above)
+            "registry_size": np.int64(cfg.registry_size),
+            "sample_clients": np.int64(self.C if self.sampling else 0),
             # codec identity, uint8-encoded (orbax trees hold arrays):
             # resume refuses a wire-format change under the carried residual
             "compress_format": np.frombuffer(
@@ -1103,6 +1299,9 @@ class FedEngine:
             # rep_trust / rep_state / rep_timer / counters: the peer
             # lifecycle must resume exactly where the crash left it
             state.update(self.reputation.checkpoint_state())
+        if self._ef_reg is not None and len(self._ef_reg):
+            # per-registry-client EF residuals (fed.cohort.EFRegistry)
+            state.update(self._ef_reg.checkpoint_state())
         save_checkpoint(
             cfg.checkpoint_dir, rnd, state,
             self.ledger.to_json() if self.ledger else None,
@@ -1136,9 +1335,13 @@ class FedEngine:
                 or ledger_blocks or self.faults.host_tamper is not None
                 or self.faults.blocks_fusion()
                 or self.reputation is not None
+                or self.sampling
                 or cfg.topology.anomaly_filter is not None):
             # reputation needs the host between rounds: the lifecycle state
-            # machine consumes each round's evidence before gating the next
+            # machine consumes each round's evidence before gating the next.
+            # Cohort sampling does too: each round's batches/rngs/ledger ids
+            # belong to a different sampled cohort, and the EF-residual
+            # gather/scatter is host work between rounds by construction.
             return 1
         k = min(k, cfg.num_rounds - rnd)
         if cfg.eval_every:
@@ -1192,7 +1395,7 @@ class FedEngine:
     def _chunk_corrupts(self, rnd: int, k: int):
         """[k, C] transport-corruption scales for the fused fp programs
         (zeros = clean; see ``fused_tamper`` in ``__init__``)."""
-        corr = np.zeros((k, self.cfg.num_clients), np.float32)
+        corr = np.zeros((k, self.C), np.float32)
         if self.faults.fused_tamper is not None:
             for i in range(k):
                 row = self.faults.fused_tamper(rnd + i)
@@ -1205,7 +1408,7 @@ class FedEngine:
         cfg = self.cfg
         static, batches, rrngs, n_ex_list = self._chunk_inputs(rnd, k)
         rweights = self.mesh.shard_round_clients(jnp.asarray(np.stack([
-            np.full((cfg.num_clients,),
+            np.full((self.C,),
                     n_ex if cfg.weighted_agg else 1.0, np.float32)
             for n_ex in n_ex_list])))
         # compressed programs carry (params, error-feedback residual)
@@ -1242,7 +1445,7 @@ class FedEngine:
         cfg = self.cfg
         static, batches, rrngs, _ = self._chunk_inputs(rnd, k)
         masks = self.mesh.shard_round_clients(
-            jnp.ones((k, cfg.num_clients), jnp.float32))
+            jnp.ones((k, self.C), jnp.float32))
         fps = None
         carry = stacked if self._comp is None else (stacked, self._ef)
         if self.ledger is not None:
@@ -1273,7 +1476,7 @@ class FedEngine:
         consensus = prev_consensus
         if observed:
             m = self.mesh.shard_clients(
-                jnp.ones((cfg.num_clients,), jnp.float32))
+                jnp.ones((self.C,), jnp.float32))
             consensus = self.progs.collapse(stacked, m, prev_consensus)
         stats = np.asarray(stats)  # [k, C, 3]
         recs = [self._stats_to_rec(rnd + i, stats[i]) for i in range(k)]
@@ -1287,7 +1490,7 @@ class FedEngine:
         carries the real dispatch wall time, ``wall_s`` its even split
         across the chunk's rounds, and ``fused=True`` marks both as
         chunk-derived so consumers can tell interpolated from measured."""
-        C = self.cfg.num_clients
+        C = self.C
         sync_t, async_t = self.graph.info_passing_time(
             0.0, source=self.info_source, anomalies=(),
             payload_bytes=self._comms_payload_bytes())
@@ -1306,7 +1509,7 @@ class FedEngine:
         s = np.asarray(stats)  # [C, 3]
         n = np.maximum(s[:, 2], 1)
         total = s.sum(0)
-        C = self.cfg.num_clients
+        C = self.C
         raw = float(self._raw_bytes_per_client * C)
         wire = float(self._wire_bytes_per_client * C)
         return RoundRecord(
@@ -1323,13 +1526,22 @@ class FedEngine:
         )
 
     def _weights(self, mask: np.ndarray, n_ex: np.ndarray) -> jnp.ndarray:
-        w = mask * (n_ex if self.cfg.weighted_agg else 1.0)
+        w = np.asarray(mask, np.float32) * (
+            np.asarray(n_ex, np.float32) if self.cfg.weighted_agg else 1.0)
+        if not np.isfinite(w).all():
+            # a NaN/Inf weight would silently poison every aggregation
+            # fallback comparison downstream (NaN > 0 is False but NaN * x
+            # propagates); an all-MASKED round is fine — the aggregators'
+            # fallback keeps the params and the round is recorded degraded
+            raise ValueError(
+                f"non-finite aggregation weights at round mask={mask!r} "
+                f"n_ex={n_ex!r}")
         return self.mesh.shard_clients(jnp.asarray(w, jnp.float32))
 
     def _server_round(self, rnd, trainable, mask):
         batches, n_ex = self._round_batches(rnd)
         rngs = self._rngs(rnd)
-        scales = self.faults.transport_scales(rnd)
+        scales = self._transport_scales(rnd)
         if self.ledger is None and scales is None:
             w = self._weights(mask, n_ex)
             if self._comp is None:
@@ -1379,7 +1591,7 @@ class FedEngine:
         rngs = self._rngs(rnd)
         m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
         auth = None
-        scales = self.faults.transport_scales(rnd)
+        scales = self._transport_scales(rnd)
         if self.ledger is None and scales is None:
             if self._comp is None:
                 stacked, stats = self.progs.gossip_round(
@@ -1441,7 +1653,7 @@ class FedEngine:
         cfg = self.cfg
         batches, n_ex = self._round_batches(rnd)
         keys = client_round_keys(
-            jax.random.fold_in(self.root_key, 4), cfg.num_clients, rnd)
+            jax.random.fold_in(self.root_key, 4), self.C, rnd)
         snapshots, host_snaps, snap_fps, all_stats = [], [], [], []
         fp_mode = self.ledger is not None and self.faults.host_tamper is None
         # Pin the sequential path to ONE device when the model fits on one.
@@ -1469,7 +1681,7 @@ class FedEngine:
         else:
             shared, frozen = trainable, self.frozen
             host_b = jax.device_get(batches)
-        for c in range(cfg.num_clients):
+        for c in range(self.C):
             cb = (jax.tree.map(lambda x: x[c], dev_b) if pin
                   else jax.tree.map(lambda x: jnp.asarray(x[c]), host_b))
             shared, stats = self.progs.single_update(shared, frozen, cb,
@@ -1525,7 +1737,7 @@ class FedEngine:
         times = self.graph.shortest_path_times(self._payload_gb())
         src = self.info_source
         transfer = np.array([
-            times[c, src] if c != src else 0.0 for c in range(cfg.num_clients)])
+            times[c, src] if c != src else 0.0 for c in range(self.C)])
         _, n_ex = self._round_batches(0)
         n_ex = np.asarray(n_ex, np.float64)
         compute = n_ex / max(n_ex.mean(), 1e-9)  # relative local-compute cost
@@ -1533,7 +1745,7 @@ class FedEngine:
         return {
             "duration": duration,
             "next_done": duration.copy(),
-            "version": np.zeros((cfg.num_clients,), np.int64),
+            "version": np.zeros((self.C,), np.int64),
             "global_version": 0,
             "clock": 0.0,
         }
@@ -1556,7 +1768,7 @@ class FedEngine:
         ``async_server_lr`` step along the weighted-mean delta. Clients that
         haven't arrived keep training on their stale base."""
         cfg = self.cfg
-        K = cfg.async_buffer or cfg.num_clients
+        K = cfg.async_buffer or self.C
         if stacked is None:
             stacked = self.progs.broadcast(trainable)
         base = stacked  # each client's round-start params (delta reference)
@@ -1612,7 +1824,7 @@ class FedEngine:
         # staleness is reputation evidence (a chronically stale peer is a
         # flaky peer) and run observability either way
         rec.staleness = [max(int(s), 0) for s in staleness]
-        alpha = np.zeros((cfg.num_clients,), np.float32)
+        alpha = np.zeros((self.C,), np.float32)
         for c in arrived:
             # mask[c] folds in the reputation gate: a probation peer's
             # merge weight is scaled down exactly like its sync vote
@@ -1638,7 +1850,7 @@ class FedEngine:
             # arrived clients pull the fresh global and restart (adopt
             # fuses the broadcast into the select: one dispatch, no
             # materialized [C, ...] broadcast buffer)
-            pull = np.zeros((cfg.num_clients,), np.float32)
+            pull = np.zeros((self.C,), np.float32)
             pull[arrived] = 1.0
             pull_d = self.mesh.shard_clients(jnp.asarray(pull))
             stacked = self.progs.adopt(stacked, trainable, pull_d)

@@ -99,6 +99,13 @@ class RoundRecord:
     # async staleness (global version - client version) at this aggregation
     # event, for each client (async mode only)
     staleness: Optional[List[int]] = None
+    # cohort mode (SCALING.md): the round's sampled REGISTRY client ids, in
+    # stacked-slot order. Every other per-client field on this record stays
+    # in the SLOT domain — value lists (mask/auth/local_acc/reputation_*)
+    # are slot-aligned and index lists (anomalies/dropped) hold slot
+    # indices — so `cohort[slot]` is the one mapping back to registry
+    # identity. None when registry sampling is off (slot == client id).
+    cohort: Optional[List[int]] = None
     info_passing_sync_s: Optional[float] = None
     info_passing_async_s: Optional[float] = None
     # bytes-on-wire accounting (COMPRESSION.md): what this round's update

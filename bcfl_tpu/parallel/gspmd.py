@@ -52,6 +52,40 @@ def masked_weighted_mean(tree: Tree, weights: jnp.ndarray,
     return jax.tree.map(leaf_mean, tree, fallback)
 
 
+def hierarchical_weighted_mean(tree: Tree, weights: jnp.ndarray, groups: int,
+                               fallback: Optional[Tree] = None) -> Tree:
+    """Two-level masked weighted mean over the client dim (SCALING.md
+    "Cohort mode"): the ``[C]`` axis splits into ``[groups, C/groups]`` —
+    with ``groups`` = the mesh's clients-axis device count, each group is
+    exactly one device's stacked cohort slice, so the inner ``sum(axis=1)``
+    is a WITHIN-SHARD reduction XLA lowers with no collective at all, and
+    only the outer ``[groups]``-long partial-sum reduction becomes the
+    cross-device all-reduce. Same math as :func:`masked_weighted_mean`
+    (identical all-masked ``fallback`` semantics) up to floating-point
+    summation order — the explicit device -> global reduction tree of the
+    cross-replica-sharding recipe (arXiv 2004.13336), written so the
+    hierarchy is a structural property of the program, not an XLA
+    scheduling accident."""
+    C = int(weights.shape[0])
+    if groups <= 1 or C % groups:
+        return masked_weighted_mean(tree, weights, fallback=fallback)
+    per = C // groups
+    den = weights.sum()
+    empty = den <= EPS
+
+    def leaf_mean(x, fb):
+        w = weights.reshape((C,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        part = (w * x).reshape((groups, per) + x.shape[1:]).sum(axis=1)
+        mean = part.sum(axis=0) / jnp.maximum(den, EPS).astype(x.dtype)
+        if fb is None:
+            fb = x.mean(axis=0)
+        return jnp.where(empty, fb, mean)
+
+    if fallback is None:
+        return jax.tree.map(lambda x: leaf_mean(x, None), tree)
+    return jax.tree.map(leaf_mean, tree, fallback)
+
+
 # ---------------------------------------------------------------------------
 # Byzantine-robust aggregation rules (ROBUSTNESS.md).
 #
@@ -189,12 +223,23 @@ def masked_krum(tree: Tree, weights: jnp.ndarray, trim: float = 0.2,
 AGGREGATORS = ("mean", "trimmed_mean", "median", "krum")
 
 
-def make_aggregator(name: str, trim: float = 0.2):
+def make_aggregator(name: str, trim: float = 0.2,
+                    hierarchical_groups: int = 0):
     """``(tree, weights, fallback) -> tree`` aggregation closure for the
     round-program builders. ``mean`` keeps full weighted-FedAvg semantics;
     the robust rules treat ``weights`` as a participation mask only (see
-    module note above)."""
+    module note above).
+
+    ``hierarchical_groups`` > 1 switches ``mean`` to the explicit two-level
+    device -> global reduction (:func:`hierarchical_weighted_mean`, cohort
+    mode). The robust rules ignore it: order statistics over the client dim
+    are global by definition — a per-device trimmed mean of trimmed means
+    is a DIFFERENT (weaker) estimator, so 'hierarchical trimmed_mean' would
+    be a label lying about its breakdown point."""
     if name == "mean":
+        if hierarchical_groups > 1:
+            return lambda t, w, fb: hierarchical_weighted_mean(
+                t, w, hierarchical_groups, fallback=fb)
         return lambda t, w, fb: masked_weighted_mean(t, w, fallback=fb)
     if name == "trimmed_mean":
         return lambda t, w, fb: masked_trimmed_mean(t, w, trim, fallback=fb)

@@ -43,7 +43,7 @@ quarantine remains the exclusion mechanism); everyone else carries 1.0.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -157,11 +157,26 @@ class ReputationTracker:
 
     # --------------------------------------------------------------- observe
 
-    def observe(self, fault: np.ndarray) -> None:
+    def observe(self, fault: np.ndarray,
+                active: Optional[np.ndarray] = None) -> None:
         """Advance one round given per-client fault scores in [0, 1]
-        (0 = clean round, 1 = hard evidence like a failed ledger auth)."""
+        (0 = clean round, 1 = hard evidence like a failed ledger auth).
+
+        ``active`` (optional [C] bool) marks which clients actually
+        PARTICIPATED this round — cohort mode (SCALING.md) passes the
+        sampled registry ids' mask. An inactive client produced no evidence
+        at all: its EWMA trust does not drift (a non-sampled offender must
+        not launder its score back up by sitting out draws) and its
+        probation clock does not tick (probation is served in OBSERVED
+        clean rounds). Quarantine sentences tick regardless — wall rounds
+        pass for excluded peers whether or not the sampler would have
+        drawn them. ``active=None`` (the default, every pre-cohort caller)
+        treats everyone as participating — bit-identical to the old
+        behaviour."""
         cfg = self.cfg
         fault = np.clip(np.asarray(fault, np.float64), 0.0, 1.0)
+        act = (np.ones((self.n,), bool) if active is None
+               else np.asarray(active, bool))
         for c in range(self.n):
             if self.state[c] == QUARANTINED:
                 # excluded this round: no evidence, the sentence just ticks
@@ -174,6 +189,8 @@ class ReputationTracker:
                     # trust must not instantly re-quarantine a peer the
                     # window was supposed to give a second chance
                     self.trust[c] = cfg.suspect_below
+                continue
+            if not act[c]:
                 continue
             a = cfg.ewma_alpha
             self.trust[c] = (1.0 - a) * self.trust[c] + a * (1.0 - fault[c])

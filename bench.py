@@ -18,7 +18,13 @@ chip's advertised bf16 matmul rate (v5e: 197 TFLOP/s).
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
 A watchdog emits an error JSON line and exits if the backend wedges (the
 tunnel can hang indefinitely at init — r01 lost its perf evidence to an
-unguarded failure, and the r03 session saw multi-hour init hangs).
+unguarded failure, and the r03 session saw multi-hour init hangs). Before
+the staged run, a fast PREFLIGHT (one tiny device_put + readback around the
+backend-initializing jax.devices() call, its own BCFL_BENCH_PREFLIGHT_S
+deadline — default 90 s, or BCFL_BENCH_INIT_TIMEOUT_S when that is set,
+since init now happens under this stage) proves the backend alive;
+every JSON line carries ``backend_init_ok`` so a wedged-tunnel zero is
+distinguishable from a measured regression.
 
 Env knobs: BCFL_BENCH_TRACE=<dir> captures a jax.profiler trace of the timed
 block; BCFL_BENCH_ROUNDS/STEPS/ITERS override the shape;
@@ -60,6 +66,24 @@ STAGE_TIMEOUT_S = 1200.0  # per STAGE, reset on every stage transition
 # tunnel hangs forever, and the error JSON must outrun the DRIVER's own
 # process timeout (r03's recording died rc=124 with no line at all)
 INIT_TIMEOUT_S = float(os.environ.get("BCFL_BENCH_INIT_TIMEOUT_S", "300"))
+# backend-init PREFLIGHT: before committing to the staged run, one tiny
+# device_put + host readback under its own short deadline. jax.devices()
+# — the call a wedged tunnel actually hangs in — runs under THIS stage, so
+# a wedge (the BENCH_r03-r05 "stage made no progress" artifacts) fails in
+# ~1.5 min stamped backend_init_ok=false — distinguishable at a glance
+# from a real throughput regression, which fails later with
+# backend_init_ok=true. Default 90 s = >2x the documented healthy
+# tunnelled init (20-40 s); an explicit BCFL_BENCH_INIT_TIMEOUT_S still
+# governs init (it becomes the preflight deadline) since init now happens
+# here, not under the import-stage INIT_TIMEOUT_S.
+PREFLIGHT_TIMEOUT_S = float(os.environ.get(
+    "BCFL_BENCH_PREFLIGHT_S",
+    os.environ.get("BCFL_BENCH_INIT_TIMEOUT_S", "90")))
+# tri-state preflight outcome stamped into EVERY emitted JSON line:
+# None = never reached (config error), False = attempted and not yet
+# passed (a preflight-stage timeout fires with this), True = backend
+# proved alive before the run
+_BACKEND_INIT_OK = None
 
 PEAK_FLOPS = {  # bf16 peak matmul throughput per chip
     "TPU v5 lite": 197e12,
@@ -94,6 +118,7 @@ def _error_json(stage: str, err: str):
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
+        "backend_init_ok": _BACKEND_INIT_OK,
         "error": f"{stage}: {err[:400]}",
     }
     # a wedged-tunnel window at the recording moment must not erase the
@@ -202,6 +227,7 @@ def main():
         if prng:
             jax.config.update("jax_default_prng_impl", prng)
         import jax.numpy as jnp
+        import numpy as np
 
         from bcfl_tpu.core.fence import fence
         from bcfl_tpu.core.mesh import client_mesh
@@ -209,7 +235,20 @@ def main():
         from bcfl_tpu.fed.synthetic import synthetic_round_inputs
         from bcfl_tpu.models import build
 
+        # fast backend-init preflight (own short deadline): jax.devices()
+        # is the call that actually initializes the backend — the one a
+        # wedged tunnel hangs in — and the device_put + host readback
+        # proves the data path end to end before the 300 s init budget or
+        # the staged run is ever committed to
+        global _BACKEND_INIT_OK
+        _BACKEND_INIT_OK = False
+        watchdog.stage("preflight", PREFLIGHT_TIMEOUT_S)
         devices = jax.devices()
+        probe = np.asarray(jax.device_put(jnp.arange(16, dtype=jnp.int32)))
+        if int(probe.sum()) != 120:
+            raise RuntimeError(f"preflight readback mismatch: {probe!r}")
+        _BACKEND_INIT_OK = True
+
         n_dev = len(devices)
         kind = devices[0].device_kind
         peak = PEAK_FLOPS.get(kind)
@@ -324,6 +363,7 @@ def main():
             "value": round(sps_chip, 2),
             "unit": "samples/sec/chip",
             "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC, 2),
+            "backend_init_ok": _BACKEND_INIT_OK,
             "device": kind,
             "params_m": round(n_params / 1e6, 1),
             "steps_per_dispatch": ROUNDS * STEPS,

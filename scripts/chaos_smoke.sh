@@ -91,4 +91,38 @@ print("%-12s | %d degraded (model-kept) rounds, ledger chain %s"
       % ("engine", degraded,
          "OK" if res.ledger.verify_chain() == -1 else "BROKEN"))
 EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Cohort scale-out smoke (SCALING.md "Cohort mode"): a 1000-client registry
+# sampled 8 clients/round on the CPU mesh, tiny model — proves the
+# registry axis cannot regress to O(registry) device work without this
+# script noticing before a TPU window does. Deterministic (seeded sampler).
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import tests.conftest  # noqa: F401  (8-device CPU mesh)
+from bcfl_tpu.config import FedConfig, PartitionConfig
+from bcfl_tpu.fed.engine import FedEngine
+
+cfg = FedConfig(
+    name="cohort_smoke", dataset="synthetic", model="tiny-bert",
+    mode="server", registry_size=1000, sample_clients=8, num_rounds=3,
+    seq_len=16, batch_size=4, max_local_batches=2, eval_every=0,
+    partition=PartitionConfig(kind="iid", iid_samples=8))
+eng = FedEngine(cfg)
+res = eng.run()
+assert eng.mesh.num_clients == 8, "device axis must be cohort-sized"
+for x in (np.asarray(v) for v in
+          __import__("jax").tree.leaves(
+              __import__("jax").device_get(res.trainable))):
+    assert np.isfinite(x).all(), "NaN/Inf under cohort sampling"
+seen = sorted({c for r in res.metrics.rounds for c in r.cohort})
+print()
+print("cohort smoke: registry=1000, cohort=8/round, %d rounds" % cfg.num_rounds)
+for r in res.metrics.rounds:
+    print("  round %d cohort=%s wall=%.2fs" % (r.round, r.cohort, r.wall_s))
+print("  unique clients touched: %d; server_round traces: %d (pinned 1)"
+      % (len(seen), eng.progs.server_round._cache_size()))
+assert eng.progs.server_round._cache_size() == 1, "per-round retrace!"
+EOF
 exit $?

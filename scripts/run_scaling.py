@@ -67,16 +67,27 @@ def main(argv=None):
                     help="write <out>/scaling.json + curves but do NOT "
                     "rewrite SCALING.md (for fallback runs that must not "
                     "clobber a better run's table)")
+    # --- registry axis (SCALING.md "Cohort mode") ---
+    ap.add_argument("--registry-sizes", type=int, nargs="*", default=None,
+                    help="run the COHORT sweep instead of the counts "
+                    "ladder: one server-mode run per registry size, each "
+                    "sampling --cohort-samples clients per round. Records "
+                    "steady-state per-round wall per (registry, cohort) "
+                    "point -> <out>/cohort_scaling.json. The claim under "
+                    "test: wall scales with the sampled cohort, "
+                    "sublinearly in registry size")
+    ap.add_argument("--cohort-samples", type=int, nargs="*", default=[8],
+                    help="sampled-cohort sizes for the registry sweep "
+                    "(default: 8)")
     args = ap.parse_args(argv)
 
     # multi-client CPU meshes on a loaded host abort when a device thread
     # lags >40s behind the XLA collective rendezvous; raise the timeouts
-    # BEFORE the backend initializes (same setup as run_results.py)
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "collective_call_terminate" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-            " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    # BEFORE the backend initializes (same setup as run_results.py; the
+    # helper is version-gated — jaxlib 0.4.x FATALs on unknown XLA flags)
+    from bcfl_tpu.core.hostenv import raise_cpu_collective_timeouts
+
+    raise_cpu_collective_timeouts()
 
     if args.platform:
         import jax
@@ -88,6 +99,8 @@ def main(argv=None):
     from bcfl_tpu.viz.plots import accuracy_curves
 
     os.makedirs(args.out, exist_ok=True)
+    if args.registry_sizes:
+        return _registry_sweep(args, FedConfig, PartitionConfig, run)
     study = {}
     for count in args.counts:
         name = f"scale_{count}c"
@@ -148,6 +161,56 @@ def main(argv=None):
         _write_md(meta, study)
     print(f"\nwrote {args.out}/scaling.json"
           + ("" if args.no_md else " and SCALING.md"), flush=True)
+
+
+def _registry_sweep(args, FedConfig, PartitionConfig, run):
+    """Cohort-mode scaling sweep (SCALING.md "Cohort mode"): per-round wall
+    time as a function of (registry_size, sampled cohort). The tentpole
+    claim — per-round cost is bounded by the COHORT, sublinear in registry
+    size — shows up as ~flat rows across registry sizes and growing columns
+    across cohort sizes. Round 0 is excluded from the steady-state mean
+    (it pays the program compiles)."""
+    import numpy as np
+
+    points = []
+    for registry in args.registry_sizes:
+        for cohort in args.cohort_samples:
+            name = f"cohort_r{registry}_s{cohort}"
+            cfg = FedConfig(
+                name=name, model=args.model, dataset=args.dataset,
+                num_labels=args.num_labels, mode="server",
+                registry_size=registry, sample_clients=cohort,
+                num_rounds=args.rounds, seq_len=args.seq_len,
+                eval_every=0,
+                partition=PartitionConfig(kind="iid",
+                                          iid_samples=args.iid_samples),
+                **({"seed": args.seed} if args.seed is not None else {}),
+            )
+            print(f"\n===== {name} =====", flush=True)
+            res = run(cfg, verbose=True)
+            walls = [r.wall_s for r in res.metrics.rounds]
+            steady = walls[1:] or walls
+            points.append({
+                "registry_size": registry, "sample_clients": cohort,
+                "round_wall_s": [round(w, 4) for w in walls],
+                "steady_wall_s_mean": round(float(np.mean(steady)), 4),
+                "final_train_loss": res.metrics.rounds[-1].train_loss,
+            })
+    path = os.path.join(args.out, "cohort_scaling.json")
+    with open(path, "w") as f:
+        json.dump({"meta": {"model": args.model, "dataset": args.dataset,
+                            "rounds": args.rounds, "seq_len": args.seq_len,
+                            "iid_samples": args.iid_samples,
+                            "registry_sizes": args.registry_sizes,
+                            "cohort_samples": args.cohort_samples},
+                   "points": points}, f, indent=2)
+    print(f"\n{'registry':>9} | {'cohort':>6} | steady wall s/round")
+    print("-" * 40)
+    for p in points:
+        print(f"{p['registry_size']:>9} | {p['sample_clients']:>6} | "
+              f"{p['steady_wall_s_mean']}")
+    print(f"\nwrote {path}", flush=True)
+    return 0
 
 
 def _write_md(meta, study):

@@ -1,0 +1,407 @@
+"""Cohort-batched client scale-out (SCALING.md "Cohort mode"): the registry
+sampler, hierarchical aggregation, and the engine's registry-keyed state
+against the scale-out contracts:
+
+- seeded sampler determinism: same seed => same per-round cohorts, and a
+  crash/resume reproduces the remaining rounds' cohorts bit-for-bit,
+- device work is bounded by the COHORT: a 10k-client registry runs with an
+  8-wide mesh axis, zero per-round retraces (cohort ids are runtime
+  values, never trace-time shapes),
+- the composition case: registry sampling x trimmed_mean x int8+topk
+  compression x ledger auth x the reputation lifecycle in one run, with
+  crash + restore + re-run bit-identical (sampler stream, per-registry
+  EF residuals, registry-sized reputation arrays all carried),
+- an all-masked sampled cohort takes the existing degraded-round path
+  (params kept, ``rec.degraded``) instead of producing NaN weights,
+- the declared capability table rejects what cannot compose (serverless /
+  async / faithful / partition lane) loudly at config time.
+
+Marker ``cohort`` (tier-1: these are all 'not slow');
+``scripts/chaos_smoke.sh`` additionally runs a live 1k-registry smoke.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bcfl_tpu.compression import CompressionConfig
+from bcfl_tpu.config import FedConfig, LedgerConfig, PartitionConfig
+from bcfl_tpu.faults import FaultPlan, SimulatedCrash
+from bcfl_tpu.fed.cohort import ClientSampler, EFRegistry
+from bcfl_tpu.fed.engine import FedEngine
+from bcfl_tpu.parallel import gspmd
+from bcfl_tpu.reputation import QUARANTINED, ReputationConfig, ReputationTracker
+
+pytestmark = [pytest.mark.cohort]
+
+
+def _cohort_cfg(**kw):
+    """Same smallest-config shapes as the chaos matrix `_tiny` (seq 16,
+    batch 4, 8 iid samples, 2 local batches) so traces dedupe against the
+    memoized program sets other suites already compiled."""
+    base = dict(
+        dataset="synthetic", model="tiny-bert", mode="server",
+        registry_size=64, sample_clients=8, num_rounds=3,
+        seq_len=16, batch_size=4, max_local_batches=2, eval_every=0,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def _leaves(tree):
+    return jax.tree.leaves(jax.device_get(tree))
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------------ config surface
+
+
+def test_capability_table_and_validation():
+    # the sub-flags are meaningless without a registry (fail-loudly stance)
+    with pytest.raises(ValueError, match="registry_size"):
+        FedConfig(sample_clients=4)
+    with pytest.raises(ValueError, match="registry_size"):
+        FedConfig(cohort_size=2)
+    # cannot draw a cohort larger than the registry
+    with pytest.raises(ValueError, match="without replacement"):
+        FedConfig(registry_size=4, sample_clients=8)
+    # cohort_size shapes the per-device stack: must divide the cohort
+    with pytest.raises(ValueError, match="divide"):
+        FedConfig(registry_size=64, sample_clients=8, cohort_size=3)
+    # the per-device pin truncates the device list — multi-host pods would
+    # strand other processes' devices, so the combination is refused
+    with pytest.raises(ValueError, match="pod"):
+        FedConfig(registry_size=64, sample_clients=8, cohort_size=2,
+                  pod=True)
+    # declared capability table: what cannot hold per-client state for a
+    # registry >> cohort is rejected at config time, not silently degraded
+    with pytest.raises(ValueError, match="server"):
+        FedConfig(registry_size=64, sample_clients=8, mode="serverless")
+    with pytest.raises(ValueError, match="async"):
+        FedConfig(registry_size=64, sample_clients=8, sync="async")
+    with pytest.raises(ValueError, match="faithful"):
+        FedConfig(registry_size=64, sample_clients=8, faithful=True)
+    with pytest.raises(ValueError, match="partition"):
+        FedConfig(registry_size=64, sample_clients=8,
+                  faults=FaultPlan(partition_groups=((0, 1), (2, 3)),
+                                   partition_rounds=(0,)))
+    # negative sizes
+    with pytest.raises(ValueError, match=">= 0"):
+        FedConfig(registry_size=-1)
+
+
+def test_async_buffer_validated_against_num_clients():
+    # an oversized buffer could never fill — refused at config time
+    with pytest.raises(ValueError, match="async_buffer"):
+        FedConfig(sync="async", num_clients=4, async_buffer=5)
+    with pytest.raises(ValueError, match="async_buffer"):
+        FedConfig(async_buffer=-1)
+    # boundary and 0 (= everyone) stay legal
+    FedConfig(sync="async", num_clients=4, async_buffer=4)
+    FedConfig(sync="async", num_clients=4, async_buffer=0)
+
+
+# ----------------------------------------------------------------- sampler
+
+
+def test_sampler_determinism_and_shape():
+    s = ClientSampler(seed=42, registry_size=1000, cohort=8)
+    for rnd in range(6):
+        ids = s.cohort_ids(rnd)
+        assert ids.shape == (8,) and ids.dtype == np.int64
+        assert len(set(ids.tolist())) == 8, "drew with replacement"
+        assert ids.min() >= 0 and ids.max() < 1000
+        assert (np.sort(ids) == ids).all(), "slot order must be stable"
+        # pure function: the second draw is bit-identical
+        np.testing.assert_array_equal(ids, s.cohort_ids(rnd))
+        # an equal sampler reproduces the stream (crash/resume relies on it)
+        np.testing.assert_array_equal(
+            ids, ClientSampler(42, 1000, 8).cohort_ids(rnd))
+    # rounds differ, seeds differ
+    assert not np.array_equal(s.cohort_ids(0), s.cohort_ids(1))
+    assert not np.array_equal(
+        s.cohort_ids(0), ClientSampler(43, 1000, 8).cohort_ids(0))
+    with pytest.raises(ValueError, match="cohort"):
+        ClientSampler(seed=0, registry_size=4, cohort=8)
+
+
+def test_ef_registry_round_trip():
+    tmpl = {"a": np.zeros((3,), np.float32), "b": np.zeros((2, 2), np.float32)}
+    reg = EFRegistry(tmpl)
+    ids = np.asarray([5, 11], np.int64)
+    stacked = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+               "b": np.ones((2, 2, 2), np.float32)}
+    reg.scatter(ids, stacked)
+    got = reg.gather(np.asarray([11, 3, 5], np.int64))
+    np.testing.assert_array_equal(got["a"][0], stacked["a"][1])
+    np.testing.assert_array_equal(got["a"][1], np.zeros(3))  # unseen = zeros
+    np.testing.assert_array_equal(got["a"][2], stacked["a"][0])
+    # checkpoint round-trip is exact
+    other = EFRegistry(tmpl)
+    other.restore(reg.checkpoint_state())
+    np.testing.assert_array_equal(other.gather(ids)["b"],
+                                  reg.gather(ids)["b"])
+    assert len(other) == 2
+    # stored rows are COPIES, not views pinning the whole stacked buffer
+    # (a view would keep every round's [C, ...] tree alive via .base)
+    row = reg._store[5]["a"]
+    assert row.base is None, "scatter stored a view of the stacked buffer"
+    stacked["a"][0, :] = -1.0  # mutating the source must not leak through
+    np.testing.assert_array_equal(reg.gather(np.asarray([5]))["a"][0],
+                                  np.asarray([0.0, 1.0, 2.0]))
+
+
+# ------------------------------------------------- hierarchical aggregation
+
+
+def test_hierarchical_mean_matches_flat():
+    rng = np.random.default_rng(0)
+    tree = {"w": jnp.asarray(rng.normal(size=(8, 5)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(8,)), jnp.float32)}
+    w = jnp.asarray(rng.uniform(0.0, 3.0, size=(8,)), jnp.float32)
+    fb = {"w": jnp.zeros((5,)), "b": jnp.zeros(())}
+    flat = gspmd.masked_weighted_mean(tree, w, fallback=fb)
+    for groups in (2, 4, 8):
+        hier = gspmd.hierarchical_weighted_mean(tree, w, groups, fallback=fb)
+        for a, b in zip(jax.tree.leaves(flat), jax.tree.leaves(hier)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+    # all-masked: identical fallback semantics
+    zeros = jnp.zeros((8,), jnp.float32)
+    hier0 = gspmd.hierarchical_weighted_mean(tree, zeros, 4, fallback=fb)
+    _assert_trees_equal(hier0, fb)
+    # degenerate groups fall back to the flat reduction (bit-identical)
+    one = gspmd.hierarchical_weighted_mean(tree, w, 1, fallback=fb)
+    _assert_trees_equal(one, flat)
+    # non-divisible group count must not silently mis-bucket
+    odd = gspmd.hierarchical_weighted_mean(tree, w, 3, fallback=fb)
+    _assert_trees_equal(odd, flat)
+    # the aggregator factory routes mean through the hierarchy and keeps
+    # the robust rules global (order statistics cannot be hierarchized)
+    agg = gspmd.make_aggregator("mean", hierarchical_groups=4)
+    _assert_trees_equal(agg(tree, w, fb),
+                        gspmd.hierarchical_weighted_mean(tree, w, 4,
+                                                         fallback=fb))
+    robust = gspmd.make_aggregator("trimmed_mean", hierarchical_groups=4)
+    _assert_trees_equal(robust(tree, w, fb),
+                        gspmd.masked_trimmed_mean(tree, w, 0.2, fallback=fb))
+
+
+# ------------------------------------------------------------ engine basics
+
+
+def test_cohort_run_deterministic_and_zero_retraces():
+    cfg = _cohort_cfg()
+    eng_a = FedEngine(cfg)
+    assert eng_a.mesh.num_clients == 8 and eng_a.C == 8 and eng_a.R == 64
+    assert eng_a._chunk_rounds(0) == 1  # sampling forces the per-round path
+    res_a = FedEngine(cfg).run()
+    res_b = FedEngine(cfg).run()
+    _assert_trees_equal(res_a.trainable, res_b.trainable)
+    cohorts = [r.cohort for r in res_a.metrics.rounds]
+    assert all(c is not None and len(c) == 8 for c in cohorts)
+    assert len({tuple(c) for c in cohorts}) > 1, "sampler never re-dealt"
+    assert cohorts == [r.cohort for r in res_b.metrics.rounds]
+    # runtime-value cohorts: the round program traced exactly once across
+    # two engines x 3 rounds of changing cohorts
+    eng = FedEngine(cfg)
+    eng.run()
+    assert eng.progs.server_round._cache_size() == 1
+
+
+def test_cohort_size_pins_per_device_stack():
+    eng = FedEngine(_cohort_cfg(cohort_size=2))
+    # 8-client cohort / 2 per device = 4 mesh devices
+    assert eng.mesh.per_device == 2
+    assert int(eng.mesh.mesh.shape["clients"]) == 4
+    res = eng.run()
+    for x in _leaves(res.trainable):
+        assert np.isfinite(np.asarray(x)).all()
+    # with an inner tp axis the pin budgets tp devices per client shard:
+    # 4-client cohort / 2 per device = 2 client shards x tp=2 = 4 devices,
+    # per_device stays the pinned 2 (regression: the shortfall used to fold
+    # back into a bigger stack)
+    eng_tp = FedEngine(_cohort_cfg(sample_clients=4, cohort_size=2,
+                                   tp=2, lora_rank=2))
+    assert eng_tp.mesh.per_device == 2
+    assert int(eng_tp.mesh.mesh.shape["clients"]) == 2
+    assert int(eng_tp.mesh.mesh.shape["tp"]) == 2
+
+
+def test_registry_10k_device_work_bounded_by_cohort():
+    """The acceptance sweep's in-suite twin: a 10_000-client registry runs
+    on an 8-wide mesh axis — device arrays, batches, and programs are all
+    cohort-sized, the sampler touches the full id range, and nothing
+    retraces per round. (Per-round WALL scaling vs cohort size is measured
+    by scripts/run_scaling.py --registry-sizes, where timing is meaningful;
+    here we pin the structural half of the claim.)"""
+    cfg = _cohort_cfg(registry_size=10_000, num_rounds=2)
+    eng = FedEngine(cfg)
+    assert eng.R == 10_000 and eng.mesh.num_clients == 8
+    res = eng.run()
+    ids = np.concatenate([np.asarray(r.cohort) for r in res.metrics.rounds])
+    assert ids.max() < 10_000 and len(ids) == 16
+    batches, _ = eng._round_batches(1)
+    assert jax.tree.leaves(batches)[0].shape[0] == 8  # cohort, not registry
+    assert eng.progs.server_round._cache_size() == 1
+    for x in _leaves(res.trainable):
+        assert np.isfinite(np.asarray(x)).all()
+
+
+def test_all_masked_cohort_takes_degraded_path():
+    """Satellite regression: every sampled client eliminated -> the round
+    keeps the previous params and is recorded degraded; weights never go
+    NaN (the _weights guard) and the model stays finite."""
+    cfg = _cohort_cfg(num_rounds=2,
+                      faults=FaultPlan(dropout_prob=1.0))
+    eng = FedEngine(cfg)
+    res = eng.run()
+    assert all(r.degraded for r in res.metrics.rounds)
+    assert all(all(m == 0.0 for m in r.mask) for r in res.metrics.rounds)
+    # dropped stays in the SLOT domain (indexable into mask/cohort), like
+    # anomalies — registry identity is rec.cohort[slot]
+    for r in res.metrics.rounds:
+        assert r.dropped == list(range(8))
+        assert all(r.mask[c] == 0.0 for c in r.dropped)
+    # params kept: bit-equal to the initial trainable
+    _assert_trees_equal(res.trainable, eng.trainable0)
+    # the guard itself: a NaN mask must fail loudly, not propagate
+    with pytest.raises(ValueError, match="non-finite"):
+        eng._weights(np.asarray([np.nan] * 8), np.ones(8))
+
+
+# ----------------------------------------------- registry-keyed reputation
+
+
+def test_inactive_registry_clients_hold_their_trust():
+    cfg = ReputationConfig(enabled=True, quarantine_rounds=2)
+    t = ReputationTracker(cfg, 4)
+    active = np.asarray([True, True, False, False])
+    t.observe(np.asarray([1.0, 0.0, 1.0, 0.0]), active=active)
+    # inactive clients' trust must not drift — neither down (their fault
+    # entry is garbage: they produced no evidence) nor up (laundering)
+    assert t.trust[2] == 1.0 and t.trust[3] == 1.0
+    assert t.trust[0] < 1.0 and t.trust[1] == 1.0
+    # a quarantined peer's sentence ticks even while unsampled
+    t.state[2] = QUARANTINED
+    t.timer[2] = 1
+    t.observe(np.zeros(4), active=np.zeros(4, bool))
+    assert t.state[2] != QUARANTINED  # served out to probation
+    # default active=None is the old behaviour (everyone participates)
+    t2a, t2b = ReputationTracker(cfg, 2), ReputationTracker(cfg, 2)
+    t2a.observe(np.asarray([0.3, 0.9]))
+    t2b.observe(np.asarray([0.3, 0.9]), active=np.ones(2, bool))
+    np.testing.assert_array_equal(t2a.trust, t2b.trust)
+    np.testing.assert_array_equal(t2a.state, t2b.state)
+
+
+# ------------------------------------- composition + bit-identical resume
+
+
+def _composition_cfg(tmp_path, sub: str, **kw):
+    base = _cohort_cfg(
+        registry_size=8, sample_clients=4, num_rounds=5,
+        aggregator="trimmed_mean",
+        compression=CompressionConfig(kind="int8+topk"),
+        ledger=LedgerConfig(enabled=True),
+        reputation=ReputationConfig(enabled=True, quarantine_rounds=2),
+        faults=FaultPlan(seed=11, corrupt_prob=0.6, corrupt_scale=1e6,
+                         churn_leave=((7, 3),),
+                         flaky_clients=(5,), flaky_burst_len=2,
+                         flaky_on_prob=1.0),
+        checkpoint_dir=str(tmp_path / sub), checkpoint_every=1)
+    return base.replace(**kw) if kw else base
+
+
+@pytest.mark.faults
+@pytest.mark.reputation
+def test_cohort_composition_crash_resume_bit_identical(tmp_path):
+    """The acceptance composition case: registry sampling composed with
+    trimmed_mean aggregation, int8+topk compression, ledger auth, the
+    reputation lifecycle, and the churn + flaky + corruption chaos lanes —
+    and crash + restore + re-run reproduces the uninterrupted run
+    bit-for-bit, carrying the sampler stream (pure function of the
+    checkpointed seed/registry/cohort), the per-REGISTRY EF residual store,
+    and the registry-sized reputation arrays."""
+    cfg_a = _composition_cfg(tmp_path, "a")
+    eng_a = FedEngine(cfg_a)
+    res_a = eng_a.run()
+    recs = res_a.metrics.rounds
+    # every composed lane actually fired
+    assert any(r.auth and 0.0 in r.auth for r in recs), "ledger never hit"
+    assert res_a.metrics.reputation["total_quarantine_events"] >= 1
+    assert any(r.cohort != recs[0].cohort for r in recs), "cohorts static"
+    for x in _leaves(res_a.trainable):
+        assert np.isfinite(np.asarray(x)).all()
+    # the checkpoint carries the cohort-mode state
+    from bcfl_tpu.checkpoint import restore_latest
+
+    _, state, _ = restore_latest(str(tmp_path / "a"))
+    assert int(state["registry_size"]) == 8
+    assert int(state["sample_clients"]) == 4
+    assert state.get("ef_ids") is not None, "EF registry not checkpointed"
+    assert np.asarray(state["rep_trust"]).shape == (8,)  # registry-sized
+
+    crash = _composition_cfg(
+        tmp_path, "b",
+        faults=dataclasses.replace(cfg_a.faults, crash_at_round=3))
+    with pytest.raises(SimulatedCrash):
+        FedEngine(crash).run()
+    eng_b = FedEngine(crash)
+    res_b = eng_b.run(resume=True)
+    assert [r.round for r in res_b.metrics.rounds] == [3, 4]
+    _assert_trees_equal(res_a.trainable, res_b.trainable)
+    for ra, rb in zip(res_a.metrics.rounds[3:], res_b.metrics.rounds):
+        assert ra.cohort == rb.cohort, "resume re-dealt the cohort stream"
+        assert ra.mask == rb.mask
+        assert ra.auth == rb.auth
+        assert ra.reputation_state == rb.reputation_state
+        assert ra.reputation_trust == rb.reputation_trust
+    assert (res_a.metrics.reputation["final_trust"]
+            == res_b.metrics.reputation["final_trust"])
+    # zero per-round retraces across both engines x 5 rounds of changing
+    # cohorts, quarantine flips, and churn (same pinning style as
+    # tests/test_reputation.py — these programs' shapes are shared with the
+    # chaos matrix, so traces dedupe rather than double-count)
+    for eng in (eng_a, eng_b):
+        for name in ("client_updates", "collapse", "fingerprint",
+                     "corrupt_payload"):
+            prog = getattr(eng.progs, name)
+            assert prog._cache_size() == 1, (name, prog._cache_size())
+
+
+def test_resume_refuses_cohort_identity_change(tmp_path):
+    cfg = _cohort_cfg(num_rounds=2, checkpoint_dir=str(tmp_path / "c"),
+                      checkpoint_every=1)
+    FedEngine(cfg).run()
+    with pytest.raises(ValueError, match="cohort stream"):
+        FedEngine(cfg.replace(registry_size=128)).run(resume=True)
+    with pytest.raises(ValueError, match="cohort stream"):
+        FedEngine(cfg.replace(sample_clients=4, cohort_size=0)).run(
+            resume=True)
+    # a non-cohort run must not silently resume a cohort checkpoint
+    with pytest.raises(ValueError, match="cohort stream"):
+        FedEngine(cfg.replace(registry_size=0, sample_clients=0,
+                              num_clients=8)).run(resume=True)
+
+
+def test_cli_exposes_registry_flags(tmp_path):
+    from bcfl_tpu.entrypoints.__main__ import main
+
+    out = tmp_path / "cli"
+    main(["--preset", "smoke", "--platform", "cpu", "--mode", "server",
+          "--registry-size", "32", "--sample-clients", "4",
+          "--seq-len", "16", "--batch-size", "4", "--max-local-batches", "2",
+          "--rounds", "1", "--eval-every", "0",
+          "--checkpoint-dir", str(out), "--checkpoint-every", "1"])
+    assert os.path.isdir(out)

@@ -95,6 +95,189 @@ class LedgerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DistConfig:
+    """Multi-host async P2P runtime knobs (``FedConfig.runtime='dist'``,
+    RUNTIME.md). Each peer is a real OS process owning a slice of the
+    clients; update exchange is length-prefixed TCP over loopback/DCN
+    carrying the configured codec's wire format plus ledger fingerprint
+    digests; aggregation is FedBuff-style buffered async with MEASURED
+    (arrival-order) staleness. All timeouts are hard deadlines — a hung
+    peer fails the run instead of wedging it (the harness reaps it)."""
+
+    peers: int = 2
+    host: str = "127.0.0.1"
+    # first listen port; peer p listens on base_port + p. 0 = the spawner
+    # picks free ports and passes them down (scripts/dist_async.py, CLI)
+    base_port: int = 0
+    # updates buffered per aggregation event at a component leader, in PEER
+    # updates (each carrying that peer's whole client slice). 0 = 1: merge
+    # on every arrival — the pure-async setting, and the one that makes the
+    # measured staleness distribution non-degenerate. Must be <= peers.
+    buffer: int = 0
+    # leader-side cap on waiting for the buffer to fill: merge whatever
+    # arrived once this many seconds pass since the first buffered update
+    # (a departed peer must not stall every future merge)
+    buffer_timeout_s: float = 20.0
+    # peer-process watchdog: no observable progress (no message, no local
+    # round) for this long -> the peer exits nonzero instead of wedging
+    idle_timeout_s: float = 120.0
+    # hard wall deadline for one peer process; the in-process watchdog
+    # enforces it even if the supervisor died
+    peer_deadline_s: float = 600.0
+    # checkpoint every N adopted/produced global versions (0 = off); the
+    # crash/rejoin path restores from the newest one
+    checkpoint_every_versions: int = 1
+
+    def __post_init__(self):
+        if self.peers < 2:
+            raise ValueError(
+                f"runtime='dist' needs >= 2 peers, got {self.peers}")
+        if self.buffer < 0 or self.buffer > self.peers:
+            raise ValueError(
+                f"dist buffer {self.buffer} must be in [0, peers="
+                f"{self.peers}] (it counts buffered PEER updates)")
+        for name in ("buffer_timeout_s", "idle_timeout_s",
+                     "peer_deadline_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0")
+
+
+# --- runtime capability table (RUNTIME.md §2) --------------------------------
+# Every (feature x runtime) combination is either SUPPORTED or rejected by
+# this one declared table — the single capability check the acceptance
+# contract names. Each row is ``(feature, active, {runtime: verdict})``:
+# ``active(cfg)`` says whether the feature is requested, a ``True`` verdict
+# means the runtime supports it, and a string verdict is the rejection
+# reason raised at config time. ``capability_table(cfg)`` renders the whole
+# matrix for docs/tests; FedConfig.__post_init__ walks it once.
+RUNTIME_CAPS: Tuple = (
+    ("serverless gossip mode",
+     lambda c: c.mode == "serverless",
+     {"local": True,
+      "dist": "the dist runtime exchanges updates through per-component "
+              "FedBuff leaders; the ring-gossip topology has no wire "
+              "protocol yet — use mode='server'"}),
+    ("simulated-clock sync rounds",
+     lambda c: c.sync == "sync",
+     {"local": True,
+      "dist": "runtime='dist' IS the real-clock async runtime (RUNTIME.md); "
+              "set sync='async' — there is no synchronous barrier to run"}),
+    ("simulated-clock async (sync='async')",
+     lambda c: c.sync == "async",
+     {"local": True, "dist": True}),
+    ("faithful host-sequential mode",
+     lambda c: c.faithful,
+     {"local": True,
+      "dist": "faithful mode mutates ONE shared model host-sequentially; "
+              "peers on different hosts cannot share that model"}),
+    ("cohort registry sampling",
+     lambda c: c.registry_size > 0,
+     {"local": True,
+      "dist": "registry sampling re-deals the client set per round; a "
+              "peer's client slice is its persistent identity (data, "
+              "ledger keys, checkpoints)"}),
+    ("tensor parallelism (tp > 1)",
+     lambda c: c.tp > 1,
+     {"local": True,
+      "dist": "each peer builds a single-host client mesh; inner tp "
+              "sharding across peers is not implemented"}),
+    ("sequence parallelism (sp > 1)",
+     lambda c: c.sp > 1,
+     {"local": True,
+      "dist": "each peer builds a single-host client mesh; inner sp "
+              "sharding across peers is not implemented"}),
+    ("pod-spanning mesh",
+     lambda c: c.pod,
+     {"local": True,
+      "dist": "runtime='dist' is its own multi-process deployment (one "
+              "process per peer group); pod=True is the single-program "
+              "jax.distributed path — pick one"}),
+    ("buffer donation",
+     lambda c: c.donate,
+     {"local": True,
+      "dist": "peers re-enter their round programs for the whole run; "
+              "donated-away input buffers would fail on the second round"}),
+    ("fused multi-round dispatch",
+     lambda c: c.rounds_per_dispatch > 1,
+     {"local": True,
+      "dist": "every peer round ends at the transport (send/receive is "
+              "host work by construction); there is nothing to fuse "
+              "across"}),
+    ("anomaly filter",
+     lambda c: c.topology.anomaly_filter is not None,
+     {"local": True,
+      "dist": "anomaly filters gate on the SIMULATED latency graph; the "
+              "dist runtime measures real transport and has no global "
+              "per-round view to filter"}),
+    ("reputation lifecycle",
+     lambda c: c.reputation.enabled,
+     {"local": True,
+      "dist": "the lifecycle tracker consumes a global per-round evidence "
+              "view; dist evidence is per-component and asynchronous — "
+              "not implemented"}),
+    ("robust aggregators",
+     lambda c: c.aggregator != "mean",
+     {"local": True,
+      "dist": "the buffered FedBuff merge is a host-side staleness-"
+              "weighted mean over arrived peer updates; the robust order "
+              "statistics are compiled device programs over a fixed "
+              "stacked axis — not implemented for the dist merge"}),
+    ("communication compression",
+     lambda c: c.compression.enabled,
+     {"local": True, "dist": True}),
+    ("hash-chained ledger",
+     lambda c: c.ledger.enabled,
+     {"local": True, "dist": True}),
+    ("chaos: transport partition",
+     lambda c: c.faults.partitions,
+     {"local": True, "dist": True}),  # dist: enforced at the socket layer,
+    # groups name PEERS; each connected component forks the ledger chain
+    ("chaos: stragglers",
+     lambda c: c.faults.straggler_prob > 0,
+     {"local": True, "dist": True}),  # dist: a REAL pre-send sleep — the
+    # injected delay shows up in the measured staleness distribution
+    ("chaos: client dropout",
+     lambda c: c.faults.dropout_prob > 0,
+     {"local": True,
+      "dist": "per-round dropout is a mask over a global stacked round; "
+              "dist peers have no global round to mask — not implemented"}),
+    ("chaos: transport corruption / flaky bursts",
+     lambda c: c.faults.corrupts,
+     {"local": True,
+      "dist": "injected corruption of the real TCP payload is not "
+              "implemented (the ledger verify path would catch genuine "
+              "wire damage; simulated damage needs a tap the transport "
+              "does not expose yet)"}),
+    ("chaos: churn",
+     lambda c: c.faults.churns,
+     {"local": True,
+      "dist": "peer-level churn is the crash/rejoin path (kill and "
+              "restart a peer process; scripts/dist_async.py --kill-peer "
+              "drives it), not a mask schedule"}),
+    ("chaos: host crash",
+     lambda c: c.faults.crash_at_round is not None,
+     {"local": True,
+      "dist": "kill the peer PROCESS instead (scripts/dist_async.py "
+              "--kill-peer): a real crash is the thing itself, not a "
+              "simulated one"}),
+    ("per-round central eval",
+     lambda c: c.eval_every != 0,
+     {"local": True,
+      "dist": "per-round central eval would serialize the async runtime "
+              "behind the leader; set eval_every=0 — the leader "
+              "evaluates the final global once at shutdown"}),
+)
+
+
+def capability_table(cfg: "FedConfig") -> Tuple[Tuple[str, bool, object], ...]:
+    """The resolved (feature, active, verdict) rows for ``cfg``'s runtime —
+    ``verdict`` is True (supported) or the rejection reason string."""
+    return tuple(
+        (feature, bool(active(cfg)), verdicts[cfg.runtime])
+        for feature, active, verdicts in RUNTIME_CAPS)
+
+
+@dataclasses.dataclass(frozen=True)
 class FedConfig:
     # --- experiment identity ---
     name: str = "fed"
@@ -167,13 +350,25 @@ class FedConfig:
     pod: bool = False
 
     # --- federated topology ---
+    # "local" = the whole federation runs in THIS process (simulated clock
+    # for sync="async"; every pre-existing behaviour, bit-for-bit).
+    # "dist"  = real multi-process async P2P runtime (bcfl_tpu.dist,
+    # RUNTIME.md): each peer is an OS process owning a client slice, update
+    # exchange rides length-prefixed TCP (the codec wire format + ledger
+    # fingerprint digests), aggregation is FedBuff-buffered with MEASURED
+    # staleness, and a transport partition genuinely forks the ledger
+    # chain per connected component. Feature composition is governed by
+    # RUNTIME_CAPS below — one declared capability check at config time.
+    runtime: str = "local"
     mode: str = "server"  # "server" (centralized FedAvg) | "serverless" (P2P gossip)
-    # "sync" | "async". Async is SIMULATED asynchrony under a deterministic
-    # network clock: one buffered (FedBuff-style) aggregation event per
-    # engine round, arrival order from the latency graph + chaos straggler
-    # delays, staleness decay on merged deltas. It is NOT wall-clock
-    # concurrency — see PARALLELISM.md "Async semantics" for the exact
-    # contract (what the simulated clock does and does not model).
+    # "sync" | "async". With runtime="local", async is SIMULATED asynchrony
+    # under a deterministic network clock: one buffered (FedBuff-style)
+    # aggregation event per engine round, arrival order from the latency
+    # graph + chaos straggler delays, staleness decay on merged deltas. It
+    # is NOT wall-clock concurrency — see PARALLELISM.md "Async semantics"
+    # for the real-clock vs simulated-clock contract side by side. For
+    # actual wall-clock concurrency (measured staleness, real transport)
+    # use runtime="dist", which REQUIRES sync="async".
     sync: str = "sync"
     num_clients: int = 4
     # --- cohort-batched client scale-out (SCALING.md "Cohort mode") ---
@@ -268,6 +463,9 @@ class FedConfig:
     compression: CompressionConfig = dataclasses.field(
         default_factory=CompressionConfig)
 
+    # multi-process P2P runtime knobs (runtime="dist" only; RUNTIME.md)
+    dist: DistConfig = dataclasses.field(default_factory=DistConfig)
+
     # --- checkpoint / metrics ---
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # rounds; 0 = off
@@ -283,10 +481,38 @@ class FedConfig:
     profile_dir: Optional[str] = None
 
     def __post_init__(self):
+        if self.runtime not in ("local", "dist"):
+            raise ValueError(f"unknown runtime: {self.runtime!r}")
         if self.mode not in ("server", "serverless"):
             raise ValueError(f"unknown mode: {self.mode!r}")
         if self.sync not in ("sync", "async"):
             raise ValueError(f"unknown sync: {self.sync!r}")
+        # the ONE capability check (RUNTIME_CAPS above): every requested
+        # feature is either supported on this runtime or rejected here with
+        # the table's declared reason — no per-path surprise rejections later
+        for feature, active, verdict in capability_table(self):
+            if active and verdict is not True:
+                raise ValueError(
+                    f"{feature} is not supported on runtime="
+                    f"{self.runtime!r}: {verdict}")
+        if self.runtime == "dist":
+            if self.num_clients % self.dist.peers:
+                raise ValueError(
+                    f"num_clients {self.num_clients} must split evenly "
+                    f"over {self.dist.peers} peers (each peer owns a "
+                    "fixed client slice)")
+            if self.faults.partitions and self.faults.partition_groups:
+                bad = [i for g in self.faults.partition_groups for i in g
+                       if i >= self.dist.peers]
+                if bad:
+                    raise ValueError(
+                        f"dist partition_groups name PEERS; ids {bad} are "
+                        f">= peers={self.dist.peers}")
+            if (self.faults.partitions
+                    and self.faults.partition_count > self.dist.peers):
+                raise ValueError(
+                    f"dist partition_count {self.faults.partition_count} "
+                    f"> peers {self.dist.peers}")
         if self.num_clients < 1 or self.num_rounds < 1:
             raise ValueError("num_clients and num_rounds must be >= 1")
         if self.eval_every < 0:
@@ -328,12 +554,15 @@ class FedConfig:
                 "of the parallel paths' stacked updates; faithful "
                 "(host-sequential) mode has no transport stage — use the "
                 "tamper_hook shim there")
-        if self.faults.partitions:
+        if self.faults.partitions and self.runtime == "local":
             # the partition lane routes partitioned rounds through the
             # stacked split-phase flow with per-component aggregation
             # (ROBUSTNESS.md §6); paths with no per-component form are
             # rejected here rather than silently aggregating across a
-            # partition that is supposed to exist
+            # partition that is supposed to exist. runtime='dist' is exempt
+            # from this block: there the partition is enforced at the
+            # SOCKET layer over peers (RUNTIME.md) and composes with the
+            # real-clock async exchange by construction.
             if self.sync == "async":
                 raise ValueError(
                     "chaos partition is not implemented for sync='async': "

@@ -3,6 +3,60 @@
 from __future__ import annotations
 
 import os
+import threading
+import time
+
+
+def backend_preflight(timeout_s: float = None, exit_code: int = 3) -> float:
+    """bench.py's backend-init preflight for the live driver scripts.
+
+    ``jax.devices()`` — the call a wedged TPU tunnel actually hangs in —
+    plus one tiny ``device_put`` + host readback, all under a hard watchdog
+    deadline. A healthy tunnelled init is 20-40 s; a wedge previously hung
+    run_results/tpu_perf/worker_pair SILENTLY for hours (the BENCH_r03-r05
+    "stage made no progress" artifacts). On expiry this prints a one-line
+    diagnostic and ``os._exit(exit_code)`` — fail fast with an attributable
+    message instead of eating the caller's whole time budget.
+
+    Call AFTER platform selection (``jax.config.update("jax_platforms",..)``)
+    and before any real work. Returns the measured init seconds. Deadline:
+    ``timeout_s`` arg, else ``BCFL_BENCH_PREFLIGHT_S``, else an explicit
+    ``BCFL_BENCH_INIT_TIMEOUT_S``, else 90 s — bench.py's own precedence,
+    deliberately mirrored (bench keeps an inline copy because its contract
+    is an error JSON line and it may import nothing before its watchdog is
+    armed; change the policy or the probe in BOTH places).
+    """
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(
+            "BCFL_BENCH_PREFLIGHT_S",
+            os.environ.get("BCFL_BENCH_INIT_TIMEOUT_S", "90")))
+
+    def _fire():
+        print(f"PREFLIGHT: backend init made no progress within "
+              f"{timeout_s:.0f}s (wedged TPU tunnel?); exiting "
+              f"{exit_code} — nothing was run, no artifact was written",
+              flush=True)
+        os._exit(exit_code)
+
+    timer = threading.Timer(timeout_s, _fire)
+    timer.daemon = True
+    timer.start()
+    t0 = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        devices = jax.devices()  # the backend-initializing call
+        probe = np.asarray(jax.device_put(jnp.arange(16, dtype=jnp.int32)))
+        if int(probe.sum()) != 120:
+            raise RuntimeError(f"preflight readback mismatch: {probe!r}")
+    finally:
+        timer.cancel()
+    dt = time.time() - t0
+    print(f"preflight: backend alive ({len(devices)} x "
+          f"{devices[0].device_kind}, {dt:.1f}s)", flush=True)
+    return dt
 
 
 def _jaxlib_version() -> tuple:

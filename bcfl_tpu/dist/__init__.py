@@ -1,0 +1,20 @@
+"""Real multi-host async P2P runtime (``FedConfig.runtime="dist"``).
+
+Each peer is an OS process owning a fixed slice of the clients; the update
+exchange rides length-prefixed TCP over loopback/DCN carrying the codec
+wire format (:mod:`bcfl_tpu.compression.codecs`) plus ledger fingerprint
+digests; aggregation is FedBuff-style buffered async with MEASURED
+staleness; a transport partition genuinely forks the ledger chain per
+connected component and the heal reconciles the forks. See RUNTIME.md.
+"""
+
+from bcfl_tpu.dist.harness import free_ports, reap_all, run_dist
+from bcfl_tpu.dist.launch import cfg_from_json, cfg_to_json
+from bcfl_tpu.dist.transport import PartitionGate, PeerTransport, TransportError
+from bcfl_tpu.dist.wire import pack_frame, read_frame, unpack_frame
+
+__all__ = [
+    "PartitionGate", "PeerTransport", "TransportError",
+    "cfg_from_json", "cfg_to_json", "free_ports", "pack_frame",
+    "read_frame", "reap_all", "run_dist", "unpack_frame",
+]

@@ -1,0 +1,8 @@
+"""``python -m bcfl_tpu.dist`` — one peer process of the dist runtime."""
+
+import sys
+
+from bcfl_tpu.dist.runtime import peer_main
+
+if __name__ == "__main__":
+    sys.exit(peer_main())

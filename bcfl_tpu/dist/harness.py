@@ -1,0 +1,192 @@
+"""Spawn/supervise/reap the peer processes (RUNTIME.md §5).
+
+The supervisor side of the dist runtime: write the config JSON, pick free
+ports, spawn one ``python -m bcfl_tpu.dist`` subprocess per peer, enforce a
+hard wall deadline, and REAP stragglers — a hung peer fails the run, it
+never wedges it. Every spawned process is tracked in a module-level
+registry with an ``atexit`` hook (and the test conftest calls
+:func:`reap_all` at session teardown), so an interrupted supervisor cannot
+leave orphan peers burning CPU behind a CI job.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+# every live peer Popen, registered at spawn and discarded at reap — the
+# orphan-reaper registry (tests/conftest.py drains it at session teardown)
+_LIVE: set = set()
+
+
+def reap_all() -> int:
+    """SIGKILL every still-running registered peer; returns how many."""
+    killed = 0
+    for proc in list(_LIVE):
+        if proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+                killed += 1
+            except OSError:
+                pass
+        _LIVE.discard(proc)
+    return killed
+
+
+atexit.register(reap_all)
+
+
+def free_ports(n: int, host: str = "127.0.0.1") -> List[int]:
+    """``n`` distinct currently-free TCP ports (bound-then-released)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind((host, 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def _peer_env(platform: Optional[str]) -> Dict[str, str]:
+    env = dict(os.environ)
+    # the peers build their own single-host meshes: the test conftest's
+    # 8-virtual-device XLA flag must not leak in (it would 8x every compile
+    # for a 2-client slice)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        env["XLA_FLAGS"] = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    return env
+
+
+def spawn_peer(cfg_path: str, peer_id: int, ports: List[int], run_dir: str,
+               resume: bool = False, platform: Optional[str] = None,
+               repo_root: Optional[str] = None) -> subprocess.Popen:
+    log_path = os.path.join(run_dir, f"peer{peer_id}.log")
+    cmd = [sys.executable, "-m", "bcfl_tpu.dist",
+           "--config", cfg_path, "--peer-id", str(peer_id),
+           "--ports", ",".join(str(p) for p in ports),
+           "--run-dir", run_dir]
+    if resume:
+        cmd.append("--resume")
+    if platform:
+        cmd.extend(["--platform", platform])
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        cmd, stdout=log, stderr=subprocess.STDOUT,
+        env=_peer_env(platform), cwd=repo_root or os.getcwd())
+    proc._bcfl_log = log  # keep the handle; closed at reap/collect
+    _LIVE.add(proc)
+    return proc
+
+
+def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
+             platform: Optional[str] = None,
+             kill_peer: Optional[int] = None,
+             kill_after_version: int = 1,
+             restart_delay_s: float = 2.0) -> Dict:
+    """Run one full dist federation: spawn ``cfg.dist.peers`` peer
+    processes, supervise them under a hard deadline, optionally SIGKILL
+    ``kill_peer`` mid-run once its checkpoint has reached
+    ``kill_after_version`` and restart it with ``--resume`` (the
+    crash/rejoin leg), and collect the per-peer reports.
+
+    Returns ``{"ok", "returncodes", "reports", "run_dir", ...}``; raises
+    nothing on peer failure — the caller inspects the result (and the logs
+    under ``run_dir``)."""
+    from bcfl_tpu.dist.launch import cfg_to_json
+
+    os.makedirs(run_dir, exist_ok=True)
+    n = cfg.dist.peers
+    ports = ([cfg.dist.base_port + i for i in range(n)]
+             if cfg.dist.base_port else free_ports(n, cfg.dist.host))
+    cfg_path = os.path.join(run_dir, "config.json")
+    with open(cfg_path, "w") as f:
+        f.write(cfg_to_json(cfg))
+    deadline_s = deadline_s or (cfg.dist.peer_deadline_s + 60.0)
+
+    procs = {p: spawn_peer(cfg_path, p, ports, run_dir, platform=platform)
+             for p in range(n)}
+    rcs: Dict[int, Optional[int]] = {p: None for p in range(n)}
+    killed_restarted = False
+    kill_record = None
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        for p, proc in list(procs.items()):
+            rc = proc.poll()
+            if rc is not None and rcs[p] is None:
+                rcs[p] = rc
+                _LIVE.discard(proc)
+                getattr(proc, "_bcfl_log", None) and proc._bcfl_log.close()
+        if (kill_peer is not None and not killed_restarted
+                and rcs.get(kill_peer) is None):
+            ckpt = os.path.join(run_dir, f"ckpt_peer{kill_peer}",
+                                f"round_{kill_after_version:06d}")
+            if os.path.isdir(ckpt):
+                proc = procs[kill_peer]
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+                _LIVE.discard(proc)
+                getattr(proc, "_bcfl_log", None) and proc._bcfl_log.close()
+                kill_record = {"peer": kill_peer,
+                               "killed_at_s": time.time() - t0,
+                               "checkpoint_seen": ckpt}
+                time.sleep(restart_delay_s)
+                procs[kill_peer] = spawn_peer(
+                    cfg_path, kill_peer, ports, run_dir, resume=True,
+                    platform=platform)
+                rcs[kill_peer] = None
+                killed_restarted = True
+        if all(rc is not None for rc in rcs.values()):
+            break
+        time.sleep(0.25)
+    else:
+        # deadline: reap whoever is still running — they exit nonzero
+        for p, proc in procs.items():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+                rcs[p] = proc.returncode
+                _LIVE.discard(proc)
+                getattr(proc, "_bcfl_log", None) and proc._bcfl_log.close()
+
+    reports = {}
+    for p in range(n):
+        path = os.path.join(run_dir, f"report_peer{p}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                reports[p] = json.load(f)
+    logs = {}
+    for p in range(n):
+        lp = os.path.join(run_dir, f"peer{p}.log")
+        if os.path.exists(lp):
+            with open(lp, errors="replace") as f:
+                logs[p] = f.read()[-2000:]
+    ok = (all(rc == 0 for rc in rcs.values())
+          and all(reports.get(p, {}).get("status") == "ok"
+                  for p in range(n)))
+    return {
+        "ok": ok,
+        "process_count": n,
+        "returncodes": {str(p): rcs[p] for p in range(n)},
+        "reports": reports,
+        "log_tails": logs,
+        "kill": kill_record,
+        "run_dir": run_dir,
+        "wall_s": time.time() - t0,
+    }

@@ -1,0 +1,897 @@
+"""PeerRuntime — one OS process of the real multi-host async runtime.
+
+Each peer owns a fixed slice of the global client set and drives its own
+local training loop on its own JAX backend; peers exchange updates over
+:mod:`bcfl_tpu.dist.transport` and aggregate FedBuff-style at a **component
+leader** (the lowest peer id reachable in the peer's connected component —
+peer 0 when the network is whole). See RUNTIME.md for the protocol.
+
+The essentials, and how they map onto the existing machinery:
+
+- **Training + wire encode** go through the engine's update-exchange seam
+  (:meth:`bcfl_tpu.fed.engine.FedEngine._exchange_updates`, ``commit=False``):
+  the wire quantity is exactly what the local split-phase rounds exchange —
+  the codec payload (encoded delta vs the peer's adopted base) under
+  compression, the post-train stacked params otherwise — and the announced
+  ledger digests are the same ``entry_digest`` binding the local flow
+  chains.
+- **Buffered async aggregation** mirrors ``FedEngine._async_round``'s math
+  with MEASURED staleness: an update's staleness is the leader's version
+  minus the sender's base version at the moment it is merged (arrival
+  order, not a simulated clock), its merge weight is
+  ``staleness_decay ** staleness`` (times example counts under
+  ``weighted_agg``), and the global takes an ``async_server_lr`` step along
+  the weighted-mean delta with the ``_async_merge_scale`` rescale.
+- **Ledger forking is real**: the leader commits each merged update's
+  ANNOUNCED digests to its chain and verifies what ARRIVED; during a
+  transport partition each component's leader extends its own chain from
+  the common prefix (two distinct heads exist), and the heal runs the
+  segment-verified deterministic merge (:meth:`Ledger.merge_rows` /
+  ``adopt_merge``) plus a participation-weighted model consensus through
+  the engine's ``collapse`` program.
+- **Crash/rejoin** rides the existing checkpoint store: every adopted or
+  produced version is checkpointed (``save_checkpoint``); a restarted peer
+  restores the newest valid state (``restore_latest``), HELLOs the leader,
+  and re-enters with a verified chain replica.
+- **Nothing can wedge**: a hard per-process deadline, an idle watchdog (no
+  version progress), and a parent-death check each force a nonzero exit,
+  and the spawning harness reaps stragglers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class MergeRecord:
+    version: int
+    leader: int
+    arrivals: List[Dict]  # per merged update: peer/staleness/latency/auth
+    rejected: List[Dict]  # updates excluded (stale lineage, auth failure)
+    wall_s: float
+    solo: bool  # produced while partitioned (a fork extension)
+
+
+def _peer_engine_cfg(cfg, local_clients: int):
+    """The embedded per-peer engine config: the peer's own client slice on a
+    plain local mesh. The dist layer owns async/partition/eval semantics, so
+    the inner engine runs the vanilla sync-server build (its round LOOP is
+    never used — only its data/program/ledger/exchange machinery)."""
+    from bcfl_tpu.faults import FaultPlan
+
+    return cfg.replace(
+        runtime="local", sync="sync", mode="server",
+        num_clients=local_clients, eval_every=0,
+        faults=FaultPlan(),  # partition/straggler lanes act at the transport
+        checkpoint_dir=None, checkpoint_every=0,
+        rounds_per_dispatch=1, donate=False)
+
+
+class PeerRuntime:
+    def __init__(self, cfg, peer_id: int, ports: List[int], run_dir: str,
+                 resume: bool = False):
+        import jax
+
+        from bcfl_tpu.dist.transport import PartitionGate, PeerTransport
+        from bcfl_tpu.fed.engine import FedEngine
+
+        self.cfg = cfg
+        self.peer_id = int(peer_id)
+        self.peers = cfg.dist.peers
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        k = cfg.num_clients // self.peers
+        self.local_clients = k
+        self.global_ids = np.arange(self.peer_id * k, (self.peer_id + 1) * k)
+
+        self.eng = FedEngine(_peer_engine_cfg(cfg, k))
+        self._jax = jax
+        if self.eng._comp is not None:
+            self.eng._ef = self.eng.progs.ef_init(self.eng.trainable0)
+
+        self.trainable = self.eng.trainable0
+        self.version = 0
+        self.local_round = 0
+        self.chain = self.eng.ledger  # the peer's chain replica (or None)
+        # version -> (model tree or None, chain-head hex at creation): what
+        # an uncompressed update's delta is computed against at the leader,
+        # lineage-checked so a fork-based update can never merge into the
+        # wrong component's history (compressed runs keep only the head —
+        # see _note_version)
+        self.history: Dict[int, tuple] = {
+            0: (self.trainable if self.eng._comp is None else None,
+                self._head())}
+        self.history_limit = 16
+
+        self.merges: List[MergeRecord] = []
+        self.adopted: List[int] = []
+        self._last_broadcast_len = 0  # suffix base of the next chain broadcast
+        self._last_hello = 0.0
+        self.fork: Optional[Dict] = None
+        self.reconcile: Optional[Dict] = None
+        self.send_failures = 0
+        self._buffer: List[tuple] = []  # (header, trees, recv_time)
+        self._partitioned = False
+        self._fork_comps = None
+        self._pending_reconcile = False
+        self._last_reconcile_try = 0.0
+        self._stop = False
+        self._resumed = False
+
+        plan = cfg.faults if cfg.faults.partitions else None
+        # the span clock is the peer's LOCAL ROUND: it advances autonomously
+        # with the peer's own training loop, so every peer traverses the
+        # partition span even while cross-partition messages are dropped (a
+        # version-keyed clock can deadlock: versions only advance via the
+        # very messages the partition blocks)
+        self.gate = PartitionGate(plan, self.peers,
+                                  version_fn=lambda: self.local_round)
+        host = cfg.dist.host
+        self.transport = PeerTransport(
+            self.peer_id, [(host, p) for p in ports], gate=self.gate,
+            io_timeout_s=min(60.0, cfg.dist.peer_deadline_s))
+
+        self.ckpt_dir = os.path.join(run_dir, f"ckpt_peer{self.peer_id}")
+        if resume:
+            self._restore()
+
+        # --- watchdogs: a hung peer FAILS, it never wedges the run ---
+        self._t0 = time.time()
+        self._last_version_change = time.time()
+        self._ppid = os.getppid()
+        self._deadline_timer = threading.Timer(
+            cfg.dist.peer_deadline_s, self._deadline_fire)
+        self._deadline_timer.daemon = True
+        self._deadline_timer.start()
+
+    # ------------------------------------------------------------- watchdogs
+
+    def _deadline_fire(self):
+        logger.error("peer %d: hard deadline %.0fs expired; exiting",
+                     self.peer_id, self.cfg.dist.peer_deadline_s)
+        self._write_report(status="deadline")
+        os._exit(3)
+
+    def _check_watchdogs(self):
+        if os.getppid() != self._ppid:
+            logger.error("peer %d: supervisor died; exiting", self.peer_id)
+            self._write_report(status="orphaned")
+            os._exit(5)
+        if (time.time() - self._last_version_change
+                > self.cfg.dist.idle_timeout_s):
+            logger.error("peer %d: no version progress for %.0fs; exiting",
+                         self.peer_id, self.cfg.dist.idle_timeout_s)
+            self._write_report(status="stalled")
+            os._exit(4)
+
+    # ------------------------------------------------------------------ utils
+
+    def _head(self) -> Optional[str]:
+        return self.chain.head.hex() if self.chain is not None else None
+
+    def _component(self):
+        return self.gate.component_of(self.peer_id)
+
+    def _leader(self) -> int:
+        return min(self._component())
+
+    def _note_version(self):
+        self._last_version_change = time.time()
+        # the model part of a history entry is only ever read by the
+        # UNCOMPRESSED delta path (_prepare_update); compressed runs keep
+        # just the lineage head — never 16 pinned copies of the params
+        model = self.trainable if self.eng._comp is None else None
+        self.history[self.version] = (model, self._head())
+        for v in sorted(self.history):
+            if len(self.history) <= self.history_limit:
+                break
+            del self.history[v]
+
+    def _cast(self, tree):
+        import jax.numpy as jnp
+
+        pd = jnp.dtype(self.cfg.param_dtype)
+        return self._jax.tree.map(
+            lambda x: jnp.asarray(x, pd)
+            if jnp.issubdtype(np.asarray(x).dtype, np.floating)
+            else jnp.asarray(x), tree)
+
+    def _to_device(self, tree_np):
+        import jax.numpy as jnp
+
+        return self.eng.mesh.shard_clients(
+            self._jax.tree.map(jnp.asarray, tree_np))
+
+    # ----------------------------------------------------------- train + send
+
+    def _train_once(self):
+        """One local round: every local client fine-tunes from the peer's
+        current base; the wire payload comes out of the engine's shared
+        update-exchange seam."""
+        import jax
+
+        from bcfl_tpu.core import client_round_keys
+        from bcfl_tpu.data import client_batches
+
+        cfg = self.cfg
+        rnd = self.local_round
+        tree, n_ex = client_batches(
+            self.eng.cache, self.eng.partitioner, self.global_ids, rnd,
+            cfg.batch_size, max_batches=cfg.max_local_batches)
+        batches = self._to_device(tree)
+        keys = client_round_keys(
+            jax.random.fold_in(self.eng.root_key, 4), self.global_ids, rnd)
+        rngs = self.eng.mesh.shard_clients(jax.random.key_data(keys))
+        base = self.eng.progs.broadcast(self.trainable)
+        post, _stats = self.eng.progs.local_updates(
+            base, self.eng.frozen, batches, rngs)
+        ex = self.eng._exchange_updates(
+            rnd, post, base, rngs, None, mode="async", commit=False)
+        digests = None
+        if ex.fp is not None:
+            digests = [
+                self.eng._entry_digest(ex.wire_kind, ex.fp[c]).hex()
+                for c in range(self.local_clients)]
+        header = {
+            "type": "update", "base_version": int(self.version),
+            "round": int(rnd), "wire_kind": ex.wire_kind,
+            "lineage": self.history[self.version][1],
+            "n_ex": [int(x) for x in np.asarray(n_ex)],
+            "digests": digests, "sent_at": time.time(),
+        }
+        wire_tree = jax.tree.map(np.asarray, jax.device_get(ex.sent))
+        self.local_round += 1
+
+        # chaos straggler lane, driven for REAL at the transport: the
+        # injected delay is an actual pre-send sleep, so it shows up in the
+        # measured staleness/latency distribution instead of a simulated one
+        delays = cfg.faults.straggler_delays(rnd, self.peers)
+        if delays is not None and delays[self.peer_id] > 0:
+            time.sleep(float(delays[self.peer_id]))
+
+        leader = self._leader()
+        if leader == self.peer_id:
+            self._buffer.append((dict(header, **{"from": self.peer_id}),
+                                 {"payload": wire_tree}, time.time()))
+        else:
+            from bcfl_tpu.dist.transport import TransportError
+
+            try:
+                sent = self.transport.send(leader, header,
+                                           {"payload": wire_tree})
+                if not sent:
+                    logger.info("peer %d: partition gate blocked update to "
+                                "leader %d", self.peer_id, leader)
+            except TransportError as e:
+                self.send_failures += 1
+                logger.warning("peer %d: update send failed (%s)",
+                               self.peer_id, e)
+
+    # ------------------------------------------------------- leader: merging
+
+    def _maybe_merge(self):
+        cfg = self.cfg
+        comp = self._component()
+        want = min(cfg.dist.buffer or 1, len(comp))
+        if not self._buffer:
+            return
+        first_ts = self._buffer[0][2]
+        if (len(self._buffer) < want
+                and time.time() - first_ts < cfg.dist.buffer_timeout_s):
+            return
+        buf, self._buffer = self._buffer, []
+        t0 = time.time()
+        arrivals, rejected, weighted = [], [], []
+        for header, trees, recv_t in buf:
+            out = self._prepare_update(header, trees, recv_t)
+            (arrivals if out.get("ok") else rejected).append(out["rec"])
+            if out.get("ok"):
+                weighted.append(out)
+        if weighted:
+            self._apply_merge(weighted)
+        self.version += 1
+        self._note_version()
+        rec = MergeRecord(
+            version=self.version, leader=self.peer_id, arrivals=arrivals,
+            rejected=rejected, wall_s=time.time() - t0,
+            solo=self.gate.components() is not None)
+        self.merges.append(rec)
+        self._maybe_checkpoint()
+        self._broadcast_global(healed=False)
+
+    def _prepare_update(self, header: Dict, trees: Dict, recv_t: float):
+        """Commit + verify + decode one buffered update. Returns a record
+        and, when accepted, the per-client merge weights and delta rows."""
+        cfg = self.cfg
+        src = int(header["from"])
+        base_v = int(header["base_version"])
+        staleness = max(self.version - base_v, 0)
+        rec = {"peer": src, "round": int(header["round"]),
+               "base_version": base_v, "staleness": staleness,
+               "latency_s": max(recv_t - float(header["sent_at"]), 0.0)}
+        # lineage check (BOTH wire formats) BEFORE anything touches the
+        # chain: an update based on another fork's history must go through
+        # the reconcile protocol, never a silent merge — and a protocol-
+        # rejected update must leave NO chain entries (the chain attests
+        # updates that entered aggregation, where auth failures are the
+        # recorded evidence). The sender names the chain head of its base
+        # version; it must match this leader's history for that version.
+        hist = self.history.get(base_v)
+        if hist is not None and hist[1] != header.get("lineage"):
+            rec["rejected"] = "fork lineage mismatch"
+            return {"ok": False, "rec": rec}
+        if self.eng._comp is None and hist is None:
+            # uncompressed wire ships post-train params: the delta NEEDS
+            # the base model, so an evicted base version is fatal here
+            rec["rejected"] = "unknown base version"
+            return {"ok": False, "rec": rec}
+        dev = self._to_device(trees["payload"])
+        ids = [src * self.local_clients + c
+               for c in range(self.local_clients)]
+        auth = np.ones((self.local_clients,), np.float32)
+        if self.chain is not None and header.get("digests"):
+            # commit what the sender ANNOUNCED, then authenticate what
+            # ARRIVED — the same commit -> transport -> verify order as the
+            # local split-phase flow, but across a real wire
+            kind = header["wire_kind"]
+            for c, d in zip(ids, header["digests"]):
+                self.chain.append_digest(int(header["round"]), int(c),
+                                         bytes.fromhex(d),
+                                         self.eng._client_payload_bytes)
+            fp = np.asarray(self.eng.progs.fingerprint(dev))
+            for c in range(self.local_clients):
+                recomputed = self.eng._entry_digest(kind, fp[c]).hex()
+                if recomputed != header["digests"][c]:
+                    auth[c] = 0.0
+            rec["auth"] = auth.tolist()
+        if self.eng._comp is None:
+            # uncompressed wire ships post-train params: reconstruct the
+            # delta against the (lineage-verified, above) base model
+            from bcfl_tpu.fed.engine import _tree_sub
+
+            deltas = _tree_sub(dev, self.eng.progs.broadcast(hist[0]))
+        else:
+            # compressed wire ships the encoded delta itself — FedBuff can
+            # apply it without the base; a base evicted from the bounded
+            # history merely can't be lineage-verified (recorded)
+            if hist is None:
+                rec["lineage_unverified"] = True
+            deltas = self.eng.progs.decode_delta(
+                dev, self.eng.progs.broadcast(self.trainable))
+        n_ex = np.asarray(header["n_ex"], np.float32)
+        alpha = auth * (cfg.staleness_decay ** staleness)
+        base_w = n_ex if cfg.weighted_agg else np.ones_like(n_ex)
+        alpha = alpha * base_w
+        if float(alpha.sum()) <= 0.0:
+            rec["rejected"] = "all clients eliminated (auth)"
+            return {"ok": False, "rec": rec}
+        return {"ok": True, "rec": rec, "deltas": deltas, "alpha": alpha,
+                "base_w": float(base_w.sum())}
+
+    def _apply_merge(self, updates: List[Dict]):
+        """FedBuff step along the staleness-weighted mean delta — the
+        measured-clock twin of ``FedEngine._async_round``'s merge."""
+        import jax
+        import jax.numpy as jnp
+
+        from bcfl_tpu.fed.engine import _tree_axpy, _tree_wsum
+
+        zero = jax.tree.map(jnp.zeros_like, self.trainable)
+        merged_parts, weights, base_total = [], [], 0.0
+        for u in updates:
+            w_dev = self.eng.mesh.shard_clients(jnp.asarray(u["alpha"]))
+            merged_parts.append(
+                self.eng.progs.collapse(u["deltas"], w_dev, zero))
+            weights.append(float(np.asarray(u["alpha"]).sum()))
+            base_total += u["base_w"]
+        total = sum(weights)
+        merged = _tree_wsum(
+            jnp.asarray([w / total for w in weights], jnp.float32),
+            merged_parts)
+        # decay shrinks the applied STEP, not just relative votes — the
+        # _async_merge_scale rescale (PARALLELISM.md "Async semantics")
+        scale = total / max(base_total, 1e-9)
+        self.trainable = _tree_axpy(self.trainable, merged,
+                                    self.cfg.async_server_lr * scale)
+
+    def _broadcast_global(self, healed: bool, full: bool = False):
+        import jax
+
+        from bcfl_tpu.dist.transport import TransportError
+
+        header = {
+            "type": "global", "version": int(self.version),
+            "healed": bool(healed),
+        }
+        if self.chain is not None:
+            # normal merges broadcast only the chain SUFFIX since the last
+            # broadcast (O(new entries), not O(chain)); heals broadcast the
+            # full chain — the merge rewrote history past the fork point,
+            # so no replica's suffix base is valid. A follower whose length
+            # or head doesn't match the suffix base resyncs via HELLO.
+            start = 0 if (healed or full) else self._last_broadcast_len
+            header["chain_start"] = int(start)
+            header["chain_prev_head"] = self.chain.head_at(start).hex()
+            header["chain"] = self.chain.segment(start)
+            self._last_broadcast_len = len(self.chain)
+        else:
+            header["chain"] = None
+        model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
+        for p in self._component():
+            if p == self.peer_id:
+                continue
+            try:
+                self.transport.send(p, header, {"model": model})
+            except TransportError as e:
+                self.send_failures += 1
+                logger.warning("peer %d: global broadcast to %d failed (%s)",
+                               self.peer_id, p, e)
+
+    # --------------------------------------------------- partition lifecycle
+
+    def _update_partition_state(self):
+        comps = self.gate.components()
+        if comps is not None and not self._partitioned:
+            self._partitioned = True
+            self._fork_comps = comps
+            self.fork = {
+                "at_version": int(self.version),
+                "fork_base": (int(len(self.chain))
+                              if self.chain is not None else None),
+                "head_at_fork": self._head(),
+                "component": list(self.gate.component_of(self.peer_id)),
+            }
+            logger.info("peer %d: partition began at version %d "
+                        "(component %s)", self.peer_id, self.version,
+                        self.fork["component"])
+        elif comps is None and self._partitioned:
+            self._partitioned = False
+            self.fork["head_before_heal"] = self._head()
+            self.fork["chain_len_before_heal"] = (
+                int(len(self.chain)) if self.chain is not None else None)
+            old_comp = next(c for c in self._fork_comps
+                            if self.peer_id in c)
+            if min(old_comp) == self.peer_id and self.peer_id != 0:
+                # I led a fork component: initiate the reconcile handshake
+                self._pending_reconcile = True
+            logger.info("peer %d: partition healed at version %d (head %s)",
+                        self.peer_id, self.version,
+                        (self._head() or "")[:16])
+
+    def _solo_weight(self) -> float:
+        """Participation mass this peer's fork accumulated: merged arrivals
+        across its solo merges — the reconcile consensus weight."""
+        return float(sum(len(m.arrivals) for m in self.merges if m.solo)
+                     or 1.0)
+
+    def _try_reconcile(self):
+        """Offer the fork to the global leader. Retried (throttled) until a
+        post-heal GLOBAL supersedes it: a send can 'succeed' at the socket
+        yet be dropped by the leader's own still-partitioned clock, so only
+        an adopted global clears the pending flag."""
+        import jax
+
+        from bcfl_tpu.dist.transport import TransportError
+
+        if not self.gate.allowed(self.peer_id, 0):
+            return
+        if time.time() - self._last_reconcile_try < 2.0:
+            return
+        self._last_reconcile_try = time.time()
+        header = {
+            "type": "reconcile", "version": int(self.version),
+            "rows": self.chain.segment(0) if self.chain is not None else None,
+            "weight": self._solo_weight(),
+        }
+        model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
+        try:
+            self.transport.send(0, header, {"model": model})
+        except TransportError as e:
+            self.send_failures += 1
+            logger.warning("peer %d: reconcile send failed (%s); will retry",
+                           self.peer_id, e)
+
+    def _handle_reconcile(self, header: Dict, trees: Dict):
+        """Global leader's side of the heal: verify the fork segment, adopt
+        the deterministic chain merge, reconcile the component models
+        through the collapse consensus, and broadcast the healed global."""
+        import jax.numpy as jnp
+
+        from bcfl_tpu.fed.engine import _tree_wsum
+        from bcfl_tpu.ledger import Ledger
+
+        src = int(header["from"])
+        t0 = time.time()
+        rec = {"from_peer": src, "their_version": int(header["version"]),
+               "my_version": int(self.version)}
+        their_model = self._cast(trees["model"])
+        their_weight = float(header.get("weight") or 1.0)
+        my_weight = self._solo_weight()
+        if self.chain is not None and header.get("rows") is not None:
+            rows = header["rows"]
+            their_heads = [bytes.fromhex(r["head"]) for r in rows]
+            fork = self.chain.fork_point(their_heads)
+            rec["fork_point"] = fork
+            rec["my_head"] = self._head()
+            rec["their_head"] = rows[-1]["head"] if rows else None
+            rec["forked"] = (rec["my_head"] != rec["their_head"])
+            bad = Ledger.verify_segment(
+                self.chain.head_at(fork), rows[fork:],
+                self.cfg.ledger.use_native)
+            if bad != -1:
+                # a tampered fork segment: never adopted — the requester is
+                # told the CURRENT (unmerged) global instead
+                rec["segment_rejected_at"] = int(bad)
+                self.reconcile = rec
+                logger.warning("peer %d: rejected tampered reconcile "
+                               "segment from %d (link %d)",
+                               self.peer_id, src, bad)
+                self._broadcast_global(healed=False)
+                return
+            merged = Ledger.merge_rows(self.chain.segment(fork), rows[fork:])
+            self.chain.adopt_merge(fork, merged)
+            rec["merged_entries"] = len(merged)
+            rec["merged_head"] = self._head()
+            rec["chain_ok"] = (self.chain.verify_chain() == -1)
+        # model consensus across the healed components: the participation-
+        # weighted mean of the two fork models (with aggregator pinned to
+        # "mean" on this runtime, this IS what the collapse consensus
+        # program computes — the direct form skips a one-off stacked-
+        # program compile per heal)
+        total = my_weight + their_weight
+        self.trainable = _tree_wsum(
+            jnp.asarray([my_weight / total, their_weight / total],
+                        jnp.float32),
+            [self.trainable, their_model])
+        self.version = max(self.version, int(header["version"])) + 1
+        self._note_version()
+        rec["healed_version"] = int(self.version)
+        rec["wall_s"] = time.time() - t0
+        self.reconcile = rec
+        self._maybe_checkpoint()
+        self._broadcast_global(healed=True)
+        logger.info("peer %d: reconciled fork from peer %d -> version %d "
+                    "(chain head %s)", self.peer_id, src, self.version,
+                    (self._head() or "")[:16])
+
+    # ------------------------------------------------------- follower: adopt
+
+    def _request_resync(self, leader: int):
+        """Ask the leader for a full-state GLOBAL (throttled): the suffix a
+        broadcast carried didn't extend this replica — missed broadcasts,
+        or a fork rewrite this peer hasn't seen yet."""
+        if time.time() - self._last_hello < 2.0:
+            return
+        self._last_hello = time.time()
+        try:
+            self.transport.send(leader, {"type": "hello",
+                                         "version": int(self.version)})
+        except Exception:
+            pass
+
+    def _handle_global(self, header: Dict, trees: Dict):
+        from bcfl_tpu.ledger import Ledger
+
+        version = int(header["version"])
+        if version <= self.version:
+            return
+        if self._pending_reconcile and not header.get("healed"):
+            # a fork is pending: adopting an ordinary (pre-heal) global
+            # would REPLACE this peer's fork chain — destroying the very
+            # evidence the reconcile must deliver — and clearing the offer
+            # here could cancel a reconcile the leader never received (its
+            # receiver gate drops sends while ITS clock is still in the
+            # span), deadlocking the leader's finalize guard. Defer: keep
+            # retrying the offer; the leader cannot finalize before
+            # handling it, and its HEALED broadcast supersedes everything.
+            return
+        if self.chain is not None and header.get("chain") is not None:
+            rows = header["chain"]
+            start = int(header.get("chain_start", 0))
+            if start == 0:
+                # full sync (heal / hello reply): rebuild and verify the
+                # whole replica from genesis
+                replica = Ledger(self.cfg.ledger.use_native)
+                if replica.append_rows(rows) != -1:
+                    logger.error("peer %d: global v%d carried an "
+                                 "unverifiable chain; not adopting",
+                                 self.peer_id, version)
+                    return
+                self.chain = replica
+                self.eng.ledger = replica
+            elif (start == len(self.chain)
+                  and self.chain.head.hex() == header.get("chain_prev_head")):
+                # contiguous suffix: verify incrementally as it lands
+                if self.chain.append_rows(rows) != -1:
+                    logger.error("peer %d: global v%d suffix failed link "
+                                 "verification; resyncing", self.peer_id,
+                                 version)
+                    self._request_resync(int(header["from"]))
+                    return
+            else:
+                # gap or diverged base (missed broadcasts, fork rewrite):
+                # never adopt a model whose chain this replica can't
+                # verify — request the full state instead
+                self._request_resync(int(header["from"]))
+                return
+        self.trainable = self.eng.mesh.replicate(self._cast(trees["model"]))
+        self.version = version
+        self.adopted.append(version)
+        self._note_version()
+        if header.get("healed"):
+            # ONLY the healed global clears a pending offer: it is the one
+            # broadcast that provably incorporated this peer's fork
+            self._pending_reconcile = False
+        self._maybe_checkpoint()
+
+    def _handle_hello(self, header: Dict):
+        """A (re)joining peer announces itself; the leader replies with the
+        full current state so the rejoiner re-enters verified."""
+        if self._leader() != self.peer_id:
+            return
+        import jax
+
+        from bcfl_tpu.dist.transport import TransportError
+
+        src = int(header["from"])
+        reply = {
+            "type": "global", "version": int(self.version), "healed": False,
+            "chain_start": 0,
+        }
+        if self.chain is not None:
+            from bcfl_tpu.ledger import GENESIS
+
+            reply["chain_prev_head"] = GENESIS.hex()
+            reply["chain"] = self.chain.segment(0)
+        else:
+            reply["chain"] = None
+        model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
+        try:
+            self.transport.send(src, reply, {"model": model})
+        except TransportError as e:
+            logger.warning("peer %d: hello reply to %d failed (%s)",
+                           self.peer_id, src, e)
+
+    # --------------------------------------------------- checkpoint / resume
+
+    def _maybe_checkpoint(self):
+        cfg = self.cfg
+        every = cfg.dist.checkpoint_every_versions
+        if not every or self.version % every:
+            return
+        import jax
+
+        from bcfl_tpu.checkpoint import save_checkpoint
+        from bcfl_tpu.compression import codecs as cc
+
+        state = {
+            "trainable": jax.device_get(self.trainable),
+            "version": np.int64(self.version),
+            "local_round": np.int64(self.local_round),
+            "seed": np.int64(cfg.seed),
+            "compress_format": np.frombuffer(
+                cc.wire_format(self.eng._comp).encode(), np.uint8).copy(),
+            "ef_residual": (jax.device_get(self.eng._ef)
+                            if self.eng._ef is not None else None),
+        }
+        save_checkpoint(self.ckpt_dir, self.version, state,
+                        self.chain.to_json()
+                        if self.chain is not None else None)
+
+    def _restore(self):
+        from bcfl_tpu.checkpoint import restore_latest
+        from bcfl_tpu.compression import codecs as cc
+        from bcfl_tpu.ledger import Ledger
+
+        restored = restore_latest(self.ckpt_dir)
+        if restored is None:
+            logger.warning("peer %d: --resume with no checkpoint; starting "
+                           "fresh", self.peer_id)
+            return
+        _, state, ledger_json = restored
+        ck_seed = state.get("seed")
+        if ck_seed is not None and int(ck_seed) != self.cfg.seed:
+            raise ValueError(
+                f"peer checkpoint seed {int(ck_seed)} != config seed "
+                f"{self.cfg.seed}: resuming would change every stream")
+        ck_comp = state.get("compress_format")
+        if ck_comp is not None:
+            ck_comp = bytes(np.asarray(ck_comp, np.uint8)).decode()
+            here = cc.wire_format(self.eng._comp)
+            if ck_comp != here:
+                raise ValueError(
+                    f"peer checkpoint wire format {ck_comp!r} != this "
+                    f"run's {here!r}")
+        self.trainable = self.eng.mesh.replicate(self._cast(
+            state["trainable"]))
+        self.version = int(state["version"])
+        self.local_round = int(state["local_round"])
+        if state.get("ef_residual") is not None and self.eng._comp is not None:
+            self.eng._ef = self._to_device(state["ef_residual"])
+        if ledger_json and self.chain is not None:
+            self.chain = Ledger.from_json(ledger_json,
+                                          self.cfg.ledger.use_native)
+            self.eng.ledger = self.chain
+        self.history = {
+            self.version: (self.trainable if self.eng._comp is None
+                           else None, self._head())}
+        self._resumed = True
+        logger.info("peer %d: restored checkpoint at version %d "
+                    "(round %d)", self.peer_id, self.version,
+                    self.local_round)
+
+    # ------------------------------------------------------------- main loop
+
+    def _handle(self, header: Dict, trees: Dict):
+        kind = header.get("type")
+        if kind == "update":
+            if self._leader() == self.peer_id:
+                self._buffer.append((header, trees, time.time()))
+            # an update addressed to a stale leader is dropped: the sender
+            # will rebase on the next global broadcast
+        elif kind == "global":
+            self._handle_global(header, trees)
+        elif kind == "reconcile":
+            if self.peer_id == 0:
+                self._handle_reconcile(header, trees)
+        elif kind == "hello":
+            self._handle_hello(header)
+        elif kind == "shutdown":
+            self._stop = True
+        else:
+            logger.warning("peer %d: unknown message type %r",
+                           self.peer_id, kind)
+
+    def _finalize(self):
+        import jax
+
+        from bcfl_tpu.dist.transport import TransportError
+
+        loss = acc = None
+        try:
+            loss, acc = self.eng._global_eval(self.trainable)
+        except Exception as e:  # an eval failure must not eat the report
+            logger.warning("peer %d: final eval failed (%s)", self.peer_id, e)
+        self._final_eval = {"loss": loss, "acc": acc}
+        for p in range(self.peers):
+            if p == self.peer_id:
+                continue
+            try:
+                self.transport.send(p, {"type": "shutdown",
+                                        "version": int(self.version)})
+            except TransportError:
+                pass
+        self._stop = True
+
+    def run(self) -> int:
+        logger.info("peer %d/%d up: clients %s, version %d%s",
+                    self.peer_id, self.peers, list(self.global_ids),
+                    self.version, " (resumed)" if self._resumed else "")
+        self.transport.start()
+        if self._resumed and self.peer_id != 0:
+            try:
+                self.transport.send(0, {"type": "hello",
+                                        "version": int(self.version)})
+            except Exception:
+                pass
+        try:
+            while not self._stop:
+                self._check_watchdogs()
+                msg = self.transport.recv(timeout_s=0.05)
+                while msg is not None:
+                    self._handle(*msg)
+                    msg = self.transport.recv(timeout_s=0.0)
+                if self._stop:
+                    break
+                self._update_partition_state()
+                if self._pending_reconcile:
+                    self._try_reconcile()
+                if self._leader() == self.peer_id:
+                    self._maybe_merge()
+                if (self.peer_id == 0 and self.version >= self.cfg.num_rounds
+                        and self.gate.components() is None
+                        and (self.fork is None
+                             or self.reconcile is not None)):
+                    # target version count reached, mesh whole, and any fork
+                    # this run produced has been reconciled: evaluate, tell
+                    # everyone, stop. Never finalize mid-partition (a gate-
+                    # blocked shutdown would strand the other components) or
+                    # before the heal (the fork evidence would be lost).
+                    self._finalize()
+                if self._stop:
+                    break
+                if (self.version < self.cfg.num_rounds
+                        or self.gate.components() is not None
+                        or (self.peer_id == 0 and self.fork is not None
+                            and self.reconcile is None)):
+                    # keep training past the version target while a span is
+                    # active or a fork is unresolved: the span clock IS the
+                    # local round, so stopping here would freeze the peer
+                    # inside the partition forever
+                    self._train_once()
+                else:
+                    time.sleep(0.05)  # drained; waiting for shutdown/merges
+        finally:
+            self.transport.close()
+            self._deadline_timer.cancel()
+        self._write_report(status="ok")
+        return 0
+
+    # ---------------------------------------------------------------- report
+
+    def _write_report(self, status: str):
+        staleness = [a["staleness"] for m in self.merges for a in m.arrivals]
+        latencies = [a["latency_s"] for m in self.merges for a in m.arrivals]
+        report = {
+            "peer": self.peer_id,
+            "peers": self.peers,
+            "status": status,
+            "pid": os.getpid(),
+            "resumed": self._resumed,
+            "final_version": int(self.version),
+            "local_rounds": int(self.local_round),
+            "merges": [dataclasses.asdict(m) for m in self.merges],
+            "solo_merges": sum(1 for m in self.merges if m.solo),
+            "adopted_versions": self.adopted,
+            "staleness_values": staleness,
+            "arrival_latency_s": latencies,
+            "send_failures": self.send_failures,
+            "dropped_by_gate": self.transport.dropped_by_gate,
+            "fork": self.fork,
+            "reconcile": self.reconcile,
+            "chain_len": len(self.chain) if self.chain is not None else None,
+            "chain_head": self._head(),
+            "chain_ok": (self.chain.verify_chain() == -1
+                         if self.chain is not None else None),
+            "final_eval": getattr(self, "_final_eval", None),
+            "wall_s": time.time() - self._t0,
+        }
+        path = os.path.join(self.run_dir, f"report_peer{self.peer_id}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2)
+        os.replace(tmp, path)
+
+
+def peer_main(argv=None) -> int:
+    """Entry point of one peer process (``python -m bcfl_tpu.dist``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bcfl_tpu.dist")
+    ap.add_argument("--config", required=True,
+                    help="path to the supervisor-written FedConfig JSON")
+    ap.add_argument("--peer-id", type=int, required=True)
+    ap.add_argument("--ports", required=True,
+                    help="comma-separated listen ports, one per peer")
+    ap.add_argument("--run-dir", required=True)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[peer {args.peer_id}] %(levelname)s %(message)s")
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from bcfl_tpu.dist.launch import cfg_from_json
+
+    with open(args.config) as f:
+        cfg = cfg_from_json(f.read())
+    ports = [int(p) for p in args.ports.split(",")]
+    rt = PeerRuntime(cfg, args.peer_id, ports, args.run_dir,
+                     resume=args.resume)
+    return rt.run()

@@ -1,0 +1,182 @@
+"""Host-level peer transport: loopback/DCN TCP with an injectable partition
+gate (RUNTIME.md §3).
+
+One :class:`PeerTransport` per peer process: a listener thread accepts
+connections on the peer's own port and enqueues complete frames into an
+inbox; sends open a fresh connection per message (loopback connects are
+~microseconds, and connection-per-message means a crashed receiver can
+never wedge a cached socket). Every operation runs under a hard deadline.
+
+The **partition gate** is the FaultPlan partition lane driven at the socket
+level: a callable consulted on BOTH ends of every message — the sender
+skips blocked destinations, and the receiver drops frames whose origin is
+blocked *by its own clock* (authoritative, so a component can never merge a
+cross-partition update even when the two peers disagree about exactly when
+the span started). While the gate blocks a pair, the two sides genuinely
+cannot exchange bytes — each connected component evolves (and extends its
+ledger chain) independently, which is what makes the fork real.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from bcfl_tpu.dist.wire import WireError, read_frame, write_frame
+from bcfl_tpu.faults import FaultPlan
+
+logger = logging.getLogger(__name__)
+
+
+class TransportError(RuntimeError):
+    """Send failed: destination unreachable / refused / deadline passed."""
+
+
+class PartitionGate:
+    """FaultPlan partition lane, evaluated over PEER ids at the socket.
+
+    ``components`` come from :meth:`FaultPlan.partition_components` with the
+    peer count as the population; the span clock is the owning peer's
+    **model version** (supplied via ``version_fn``), the dist analogue of
+    the local engine's round index — both sides traverse the span as their
+    own version counter crosses ``partition_rounds``. ``allowed(a, b)`` is
+    False iff the span is active on *this* peer's clock and ``a``/``b`` sit
+    in different components."""
+
+    def __init__(self, plan: Optional[FaultPlan], peers: int,
+                 version_fn: Callable[[], int]):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.peers = int(peers)
+        self.version_fn = version_fn
+
+    def components(self) -> Optional[Tuple[Tuple[int, ...], ...]]:
+        return self.plan.partition_components(int(self.version_fn()),
+                                              self.peers)
+
+    def component_of(self, peer: int) -> Optional[Tuple[int, ...]]:
+        """The peer's component, or None for an id no component contains
+        (an unknown/garbage sender — never a crash, see ``allowed``)."""
+        comps = self.components()
+        if comps is None:
+            return tuple(range(self.peers))
+        return next((c for c in comps if peer in c), None)
+
+    def allowed(self, a: int, b: int) -> bool:
+        comps = self.components()
+        if comps is None:
+            return True
+        ca, cb = self.component_of(a), self.component_of(b)
+        if ca is None or cb is None:
+            # a frame with a missing/out-of-range "from" during an active
+            # span: drop it (an unknown sender is by definition not in the
+            # receiver's component) rather than crash the serving thread
+            return False
+        return ca == cb
+
+
+class PeerTransport:
+    """Frame transport bound to one peer id.
+
+    ``addrs[p]`` is peer ``p``'s ``(host, port)``; the transport listens on
+    its own address and connects outward per send. ``gate`` (optional) is
+    consulted on both send and receive."""
+
+    def __init__(self, peer_id: int, addrs: List[Tuple[str, int]],
+                 gate: Optional[PartitionGate] = None,
+                 connect_timeout_s: float = 5.0,
+                 io_timeout_s: float = 60.0):
+        self.peer_id = int(peer_id)
+        self.addrs = list(addrs)
+        self.gate = gate
+        self.connect_timeout_s = connect_timeout_s
+        self.io_timeout_s = io_timeout_s
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.dropped_by_gate = 0  # receiver-side partition drops (observability)
+        self._server: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._closing = threading.Event()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        host, port = self.addrs[self.peer_id]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(16)
+        srv.settimeout(0.25)  # so the accept loop notices close()
+        self._server = srv
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"bcfl-dist-accept-{self.peer_id}")
+        t.start()
+        self._threads.append(t)
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_one, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                header, trees = read_frame(conn, self.io_timeout_s)
+        except (WireError, OSError, socket.timeout) as e:
+            logger.warning("peer %d: dropped malformed/stalled inbound "
+                           "frame: %s", self.peer_id, e)
+            return
+        src = int(header.get("from", -1))
+        if self.gate is not None and not self.gate.allowed(self.peer_id, src):
+            # the RECEIVER'S clock is authoritative: a frame from across the
+            # partition is dropped before anything can merge it
+            self.dropped_by_gate += 1
+            logger.info("peer %d: partition gate dropped %s from peer %d",
+                        self.peer_id, header.get("type"), src)
+            return
+        self.inbox.put((header, trees))
+
+    # ------------------------------------------------------------------ send
+
+    def send(self, to: int, header: Dict, trees: Optional[Dict] = None,
+             timeout_s: Optional[float] = None) -> bool:
+        """Send one frame to peer ``to``. Returns False when the partition
+        gate blocks the pair (not an error: the caller is supposed to act
+        partitioned); raises :class:`TransportError` when the destination
+        is genuinely unreachable within the deadline."""
+        if self.gate is not None and not self.gate.allowed(self.peer_id, to):
+            return False
+        header = dict(header, **{"from": self.peer_id})
+        host, port = self.addrs[to]
+        try:
+            with socket.create_connection(
+                    (host, port), timeout=self.connect_timeout_s) as sock:
+                write_frame(sock, header, trees,
+                            timeout_s=timeout_s or self.io_timeout_s)
+        except (OSError, socket.timeout) as e:
+            raise TransportError(
+                f"peer {self.peer_id} -> {to} ({host}:{port}): {e}") from e
+        return True
+
+    def recv(self, timeout_s: float) -> Optional[Tuple[Dict, Dict]]:
+        """Next inbound (header, trees), or None after ``timeout_s``."""
+        try:
+            return self.inbox.get(timeout=timeout_s)
+        except queue.Empty:
+            return None

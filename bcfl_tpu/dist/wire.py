@@ -1,0 +1,197 @@
+"""Length-prefixed TCP wire format for the dist runtime (RUNTIME.md §3).
+
+A **frame** is one protocol message: a small JSON header (message type,
+peer id, versions, digests, ...) plus zero or more named **trees** — pytrees
+of numpy arrays (a codec payload dict, a raw delta tree, a full model).
+Everything is length-prefixed so a reader always knows exactly how many
+bytes to wait for, and every read runs under a hard deadline — a stalled
+sender produces a timeout, never a wedged peer.
+
+Frame layout (all integers little-endian):
+
+    MAGIC "BCF1"
+    u64   frame_len                  # bytes after this field
+    u32   header_len, header JSON
+    u32   ntrees
+    per tree:
+        u32  name_len, name (utf-8)
+        u32  index_len, index JSON   # [{path, dtype, shape}] in body order
+        u64  body_len, body          # concatenated raw C-order leaf bytes
+
+Trees are nested ``dict``s of arrays (flax param trees and codec payload
+dicts both are); leaf paths join nesting keys with the ``\\x1f`` unit
+separator — NOT ``"/"``, because codec payload dicts use leaf path names
+like ``"layer/kernel"`` as single keys, and a ``/`` join would silently
+re-nest them into a different structure on the receiver (breaking both the
+decode program's payload lookup and structural equality). The round-trip is
+bit- and structure-exact, so the ledger fingerprint digests computed on the
+sender reproduce on the receiver unless the bytes really changed in flight.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"BCF1"
+# sanity cap: a corrupt/hostile length prefix must not OOM the peer. Full
+# BERT-base f32 is ~0.44 GB; 4 GiB leaves headroom for any model this repo
+# trains while still rejecting garbage lengths.
+MAX_FRAME = 4 << 30
+
+
+class WireError(RuntimeError):
+    """Malformed frame (bad magic, oversized length, truncated stream)."""
+
+
+SEP = "\x1f"  # key joiner; never appears in flax keys or codec path names
+
+
+def _flatten(tree: Any, prefix: str = "") -> list:
+    """Nested dicts of arrays -> [(path, np.ndarray)] in sorted key order
+    (a canonical order, so sender and receiver agree byte-for-byte)."""
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            if SEP in k:
+                raise WireError(f"tree key {k!r} contains the wire "
+                                "separator")
+            out.extend(_flatten(tree[k], f"{prefix}{k}{SEP}"))
+        return out
+    return [(prefix[:-1], np.ascontiguousarray(np.asarray(tree)))]
+
+
+def pack_tree(tree: Any) -> Tuple[bytes, bytes]:
+    """Tree -> (index JSON bytes, concatenated body bytes)."""
+    leaves = _flatten(tree)
+    index = [{"path": p, "dtype": a.dtype.str, "shape": list(a.shape)}
+             for p, a in leaves]
+    body = b"".join(a.tobytes() for _, a in leaves)
+    return json.dumps(index).encode(), body
+
+
+def unpack_tree(index_json: bytes, body: bytes) -> Dict:
+    """(index JSON, body) -> nested dict of numpy arrays."""
+    out: Dict = {}
+    off = 0
+    for row in json.loads(index_json.decode()):
+        dt = np.dtype(row["dtype"])
+        shape = tuple(row["shape"])
+        n = dt.itemsize * int(np.prod(shape, dtype=np.int64)) if shape else dt.itemsize
+        if off + n > len(body):
+            raise WireError(
+                f"tree body truncated at leaf {row['path']!r} "
+                f"(need {off + n}, have {len(body)})")
+        arr = np.frombuffer(body, dt, count=n // dt.itemsize,
+                            offset=off).reshape(shape).copy()
+        off += n
+        node = out
+        parts = row["path"].split(SEP)
+        for k in parts[:-1]:
+            node = node.setdefault(k, {})
+        node[parts[-1]] = arr
+    if off != len(body):
+        raise WireError(f"tree body has {len(body) - off} trailing bytes")
+    return out
+
+
+def pack_frame(header: Dict, trees: Optional[Dict[str, Any]] = None) -> bytes:
+    hdr = json.dumps(header).encode()
+    parts = [struct.pack("<I", len(hdr)), hdr,
+             struct.pack("<I", len(trees or {}))]
+    for name, tree in (trees or {}).items():
+        nb = name.encode()
+        index, body = pack_tree(tree)
+        parts.extend([
+            struct.pack("<I", len(nb)), nb,
+            struct.pack("<I", len(index)), index,
+            struct.pack("<Q", len(body)), body,
+        ])
+    payload = b"".join(parts)
+    if len(payload) > MAX_FRAME:
+        raise WireError(f"frame of {len(payload)} bytes exceeds MAX_FRAME")
+    return MAGIC + struct.pack("<Q", len(payload)) + payload
+
+
+def unpack_frame(payload: bytes) -> Tuple[Dict, Dict[str, Any]]:
+    """Bytes AFTER the magic+length prefix -> (header, {name: tree})."""
+    view = memoryview(payload)
+    off = 0
+
+    def take(n: int) -> memoryview:
+        nonlocal off
+        if off + n > len(view):
+            raise WireError("frame truncated")
+        out = view[off:off + n]
+        off += n
+        return out
+
+    (hdr_len,) = struct.unpack("<I", take(4))
+    header = json.loads(bytes(take(hdr_len)).decode())
+    (ntrees,) = struct.unpack("<I", take(4))
+    trees = {}
+    for _ in range(ntrees):
+        (name_len,) = struct.unpack("<I", take(4))
+        name = bytes(take(name_len)).decode()
+        (idx_len,) = struct.unpack("<I", take(4))
+        index = bytes(take(idx_len))
+        (body_len,) = struct.unpack("<Q", take(8))
+        trees[name] = unpack_tree(index, bytes(take(body_len)))
+    return header, trees
+
+
+def _read_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> bytes:
+    """Read exactly ``n`` bytes before ``deadline`` (``time.monotonic``
+    instant). The deadline bounds the WHOLE read, not each chunk — a
+    trickling sender (1 byte per chunk, each inside a per-recv timeout)
+    must still hit the frame deadline instead of holding the serving
+    thread and its growing buffer forever. A peer closing mid-frame raises
+    WireError instead of returning garbage."""
+    import time
+
+    chunks = []
+    remaining = n
+    while remaining:
+        if deadline is not None:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise socket.timeout(
+                    f"frame deadline expired with {remaining} bytes unread")
+            sock.settimeout(budget)
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise WireError(f"connection closed {remaining} bytes early")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket,
+               timeout_s: Optional[float] = None) -> Tuple[Dict, Dict]:
+    """Read one frame under a hard WHOLE-FRAME deadline. Raises
+    ``socket.timeout`` on deadline, :class:`WireError` on a malformed
+    stream."""
+    import time
+
+    deadline = (time.monotonic() + timeout_s
+                if timeout_s is not None else None)
+    magic = _read_exact(sock, 4, deadline)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    (length,) = struct.unpack("<Q", _read_exact(sock, 8, deadline))
+    if length > MAX_FRAME:
+        raise WireError(f"frame length {length} exceeds MAX_FRAME")
+    return unpack_frame(_read_exact(sock, int(length), deadline))
+
+
+def write_frame(sock: socket.socket, header: Dict,
+                trees: Optional[Dict[str, Any]] = None,
+                timeout_s: Optional[float] = None) -> None:
+    if timeout_s is not None:
+        sock.settimeout(timeout_s)
+    sock.sendall(pack_frame(header, trees))

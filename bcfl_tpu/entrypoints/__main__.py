@@ -30,6 +30,21 @@ def main(argv=None):
     ap.add_argument("--model", default=None)
     ap.add_argument("--dataset", default=None)
     ap.add_argument("--mode", choices=["server", "serverless"], default=None)
+    # real multi-process async P2P runtime (bcfl_tpu.dist, RUNTIME.md):
+    # spawns and supervises one OS process per peer over loopback TCP
+    ap.add_argument("--runtime", choices=["local", "dist"], default=None,
+                    help="'dist' runs the real multi-process async P2P "
+                         "runtime: --peers OS processes, each owning a "
+                         "client slice, exchanging updates over TCP with "
+                         "FedBuff-buffered aggregation and MEASURED "
+                         "staleness (implies sync=async, eval_every=0; "
+                         "feature support per the config capability table)")
+    ap.add_argument("--peers", type=int, default=None,
+                    help="peer process count for --runtime dist "
+                         "(num_clients must split evenly across them)")
+    ap.add_argument("--dist-deadline", type=float, default=600.0,
+                    help="hard per-peer wall deadline in seconds for "
+                         "--runtime dist (a hung peer fails the run)")
     ap.add_argument("--task", choices=["classification", "causal_lm"],
                     default=None,
                     help="causal_lm = federated next-token fine-tuning "
@@ -424,6 +439,23 @@ def main(argv=None):
     if args.reputation:
         overrides["reputation"] = dataclasses.replace(
             cfg.reputation, enabled=True, **rep_tweaks)
+    if args.peers is not None and args.runtime != "dist":
+        raise SystemExit("--peers only applies to --runtime dist")
+    if args.runtime is not None:
+        # runtime joins the ONE combined replace below: applying sync/mode/
+        # faults first with runtime still "local" would run the local-
+        # runtime validation on an intermediate config and reject legal
+        # dist combinations (e.g. dist + --chaos-partition) with the wrong
+        # error. Only fields the user did NOT set are defaulted — explicit
+        # conflicting overrides still fail in the capability table.
+        overrides["runtime"] = args.runtime
+        if args.runtime == "dist":
+            overrides.setdefault("sync", "async")
+            overrides.setdefault("mode", "server")
+            overrides.setdefault("eval_every", 0)
+            overrides["dist"] = dataclasses.replace(
+                cfg.dist, peers=args.peers or cfg.dist.peers,
+                peer_deadline_s=args.dist_deadline)
     cfg = cfg.replace(**overrides)
 
     fused_tamper = None
@@ -466,7 +498,32 @@ def main(argv=None):
                 row[c] = scale
             return row
 
-    if args.sweep:
+    if cfg.runtime == "dist":
+        if args.sweep or fused_tamper is not None or args.resume:
+            raise SystemExit("--runtime dist composes with neither --sweep "
+                             "nor --fused-tamper nor --resume (peer "
+                             "crash/rejoin is driven by "
+                             "scripts/dist_async.py --kill-peer)")
+        import json as _json
+        import os as _os
+
+        from bcfl_tpu.dist.harness import run_dist
+
+        run_dir = _os.path.join("/tmp", f"bcfl_dist_cli_{_os.getpid()}")
+        result = run_dist(cfg, run_dir, platform=args.platform)
+        summary = {
+            "ok": result["ok"],
+            "process_count": result["process_count"],
+            "returncodes": result["returncodes"],
+            "final_versions": {p: r.get("final_version")
+                               for p, r in result["reports"].items()},
+            "final_eval": result["reports"].get(0, {}).get("final_eval"),
+            "run_dir": run_dir,
+        }
+        print(_json.dumps(summary, indent=2), flush=True)
+        if not result["ok"]:
+            raise SystemExit(1)
+    elif args.sweep:
         if fused_tamper is not None:
             raise SystemExit("--fused-tamper does not compose with --sweep "
                              "(client indices change per sweep point)")

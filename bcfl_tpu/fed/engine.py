@@ -112,6 +112,35 @@ class RunResult:
     ledger: Optional[Ledger]
 
 
+@dataclasses.dataclass
+class ExchangeResult:
+    """One update exchange through the engine's wire seam
+    (:meth:`FedEngine._exchange_updates`) — the single code path every
+    consumer of 'what crossed the wire' shares: the per-round split-phase
+    bodies (server/serverless/partitioned/async) and the dist runtime's
+    real TCP transport (bcfl_tpu.dist, which serializes ``sent`` and ships
+    ``fp``-derived digests alongside it)."""
+
+    # what arrived at the aggregation point: the transported stacked tree
+    # (uncompressed) or the codec payload dict (compressed). Identity with
+    # the input tree when nothing touched transport (clean, uncompressed).
+    sent: object
+    # receiver-side reconstruction to aggregate/mix: decoded ref+delta for
+    # the compressed global/local modes, ``sent`` itself uncompressed,
+    # None for mode="async" (the async merge decodes deltas itself)
+    recon: object
+    # ledger 0/1 auth mask over the stacked slots (None: ledger off or
+    # commit=False)
+    auth: Optional[np.ndarray]
+    # [C, K] fingerprint rows of ``sent`` when commit=False (the dist wire
+    # path: commit/verify happens at the remote leader, so the sender only
+    # announces digests); None on the inline-commit path
+    fp: Optional[np.ndarray]
+    # the ledger struct-digest kind binding ``fp``/auth entries:
+    # "stacked" (raw trees) or "payload" (codec payloads)
+    wire_kind: str
+
+
 # Cached jitted tree helpers. Defined once at module level so they compile
 # once per shape signature — an inline ``jax.jit(lambda ...)`` built inside a
 # round body would retrace EVERY round, and an unjitted ``jax.tree.map`` of
@@ -667,15 +696,38 @@ class FedEngine:
 
     # ------------------------------------------------------- fault utilities
 
-    def _compressed_exchange(self, rnd, new_t, ref_t, rngs, scales, mode):
-        """One compressed wire exchange on the per-round split-phase path,
-        shared by the server/serverless/async round bodies so the corruption
-        sharding, transported-payload decode, and ledger verify kind can
-        never drift apart (the fused programs apply the same sequence
-        in-graph). ``mode`` picks the encoder: "global" (delta vs the
+    def _exchange_updates(self, rnd, new_t, ref_t, rngs, scales, mode,
+                          commit: bool = True) -> ExchangeResult:
+        """The update-exchange seam: one wire exchange of the round's
+        stacked updates, shared by EVERY consumer — the per-round
+        split-phase bodies (server/serverless/partitioned/async) and the
+        dist runtime's real TCP transport (bcfl_tpu.dist) — so the codec
+        encode, corruption sharding, transported-payload decode, and
+        ledger digest binding can never drift apart (the fused ``*_fp``
+        programs apply the same sequence in-graph).
+
+        ``mode`` picks the compressed encoder: "global" (delta vs the
         replicated global), "local" (vs the stacked round-start params), or
-        "async" (recon-free — the async merge decodes deltas itself).
-        Returns ``(sent_payload, recon_or_None, auth_or_None)``."""
+        "async" (recon-free — the async/dist merges decode deltas
+        themselves). Uncompressed runs ignore ``ref_t``/``mode``: the wire
+        quantity is the stacked tree itself.
+
+        ``commit=True`` (the local engine) chains+verifies inline via
+        :meth:`_ledger_verify`. ``commit=False`` (the dist wire) skips the
+        inline chain and instead returns the fingerprint rows of ``sent``
+        so the caller can announce digests to a REMOTE leader, which
+        commits and re-verifies what actually arrived."""
+        if self._comp is None:
+            sent = self._transport(new_t, scales)
+            auth = fp = None
+            if self.ledger is not None:
+                if commit:
+                    auth = self._ledger_verify(rnd, new_t, sent)
+                else:
+                    fence(sent)
+                    fp = np.asarray(self.progs.fingerprint(sent))
+            return ExchangeResult(sent=sent, recon=sent, auth=auth, fp=fp,
+                                  wire_kind="stacked")
         if mode == "async":
             payload, self._ef = self.progs.encode_deltas_async(
                 new_t, ref_t, self._ef, rngs)
@@ -694,10 +746,16 @@ class FedEngine:
                 # re-decode the TRANSPORTED payload (the clean-path recon
                 # came fused with the encode)
                 recon = self.progs.decode_recon(sent_p, ref_t, new_t)
-        auth = None
+        auth = fp = None
         if self.ledger is not None:
-            auth = self._ledger_verify(rnd, payload, sent_p, kind="payload")
-        return sent_p, recon, auth
+            if commit:
+                auth = self._ledger_verify(rnd, payload, sent_p,
+                                           kind="payload")
+            else:
+                fence(sent_p)
+                fp = np.asarray(self.progs.fingerprint(sent_p))
+        return ExchangeResult(sent=sent_p, recon=recon, auth=auth, fp=fp,
+                              wire_kind="payload")
 
     def _transport(self, stacked, scales):
         """Simulated transport of the round's stacked updates: returns the
@@ -761,16 +819,12 @@ class FedEngine:
             stacked, self.frozen, batches, rngs)
         rec = self._stats_to_rec(rnd, stats)
         scales = self._transport_scales(rnd)
-        auth = None
-        if self._comp is not None:
-            _, recon, auth = self._compressed_exchange(
-                rnd, stacked, start, rngs, scales, mode="local")
-            agg_src = recon
-        else:
-            sent = self._transport(stacked, scales)
-            if self.ledger is not None:
-                auth = self._ledger_verify(rnd, stacked, sent)
-            agg_src = sent
+        # wire exchange through the shared seam: the wire quantity is the
+        # encoded delta vs the client's round-start params (mode="local")
+        # when compression is on, the stacked tree itself otherwise
+        ex = self._exchange_updates(rnd, stacked, start, rngs, scales,
+                                    mode="local")
+        agg_src, auth = ex.recon, ex.auth
         if auth is not None:
             rec.auth = auth.tolist()
             mask = mask * auth
@@ -1561,25 +1615,17 @@ class FedEngine:
         # robust aggregators (cfg.aggregator) are the defense there.
         stacked, stats = self.progs.client_updates(
             trainable, self.frozen, batches, rngs)
-        auth = None
-        if self._comp is None:
-            sent = self._transport(stacked, scales)
-            if self.ledger is not None:
-                auth = self._ledger_verify(rnd, stacked, sent)
-                mask = mask * auth
-            w = self._weights(mask, n_ex)
-            trainable = self.progs.collapse(sent, w, trainable)
-        else:
-            # the wire quantity is the compressed payload: the ledger
-            # commits/authenticates ITS fingerprints, transport corruption
-            # perturbs IT, and the server aggregates each client's
-            # reconstruction from what arrived
-            _, recon, auth = self._compressed_exchange(
-                rnd, stacked, trainable, rngs, scales, mode="global")
-            if auth is not None:
-                mask = mask * auth
-            w = self._weights(mask, n_ex)
-            trainable = self.progs.collapse(recon, w, trainable)
+        # the wire quantity is the compressed payload when a codec is on
+        # (the ledger commits/authenticates ITS fingerprints, transport
+        # corruption perturbs IT) and the stacked tree otherwise; either
+        # way the server aggregates what ARRIVED (ex.recon)
+        ex = self._exchange_updates(rnd, stacked, trainable, rngs, scales,
+                                    mode="global")
+        auth = ex.auth
+        if auth is not None:
+            mask = mask * auth
+        w = self._weights(mask, n_ex)
+        trainable = self.progs.collapse(ex.recon, w, trainable)
         rec = self._stats_to_rec(rnd, stats)
         if auth is not None:
             rec.auth = auth.tolist()
@@ -1599,38 +1645,31 @@ class FedEngine:
             else:
                 (stacked, self._ef), stats = self.progs.gossip_round(
                     (stacked, self._ef), self.frozen, batches, m, rngs)
-        elif self._comp is not None:
-            # compressed split-phase: peers ship encoded deltas vs their own
-            # round-start params; the ledger chains payload fingerprints,
-            # transport corruption perturbs the payload, and the mix consumes
-            # each peer's RECONSTRUCTION while the sender's self-term stays
-            # its honest post-train tree (mix_recv)
-            start = stacked
-            stacked, stats = self.progs.local_updates(
-                stacked, self.frozen, batches, rngs)
-            _, recon, auth = self._compressed_exchange(
-                rnd, stacked, start, rngs, scales, mode="local")
-            if auth is not None:
-                mask = mask * auth
-                m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
-            stacked = self.progs.mix_recv(stacked, recon, m, start)
         else:
+            # split-phase: peers ship their update (the encoded delta vs
+            # their own round-start params under a codec, the stacked tree
+            # otherwise) through the shared wire seam; the mix consumes
+            # what ARRIVED (ex.recon) while each sender's self-term stays
+            # its honest post-train tree (mix_recv). An untouched wire
+            # (clean, uncompressed) keeps the one-buffer mix_only path.
             start = stacked  # pre-train params: what an all-rejected round keeps
             stacked, stats = self.progs.local_updates(
                 stacked, self.frozen, batches, rngs)
-            sent = self._transport(stacked, scales)
-            if self.ledger is not None:
-                auth = self._ledger_verify(rnd, stacked, sent)
+            ex = self._exchange_updates(rnd, stacked, start, rngs, scales,
+                                        mode="local")
+            auth = ex.auth
+            if auth is not None:
                 mask = mask * auth
                 m = self.mesh.shard_clients(jnp.asarray(mask, jnp.float32))
-            if sent is not stacked:
-                # corruption poisons only the RECEIVED copies: neighbor and
-                # aggregate terms come from the transported tree, each
-                # sender's own carry stays its honest local state
-                # (__init__ rejects corrupting serverless configs whose impl
-                # has no mix_recv, so this cannot silently fall through to a
-                # mix that rewrites the sender's state with the corruption)
-                stacked = self.progs.mix_recv(stacked, sent, m, start)
+            if ex.recon is not stacked:
+                # corruption/codec reconstruction poisons only the RECEIVED
+                # copies: neighbor and aggregate terms come from the
+                # transported tree, each sender's own carry stays its honest
+                # local state (__init__ rejects corrupting serverless
+                # configs whose impl has no mix_recv, so this cannot
+                # silently fall through to a mix that rewrites the sender's
+                # state with the corruption)
+                stacked = self.progs.mix_recv(stacked, ex.recon, m, start)
             else:
                 stacked = self.progs.mix_only(stacked, m, start)
         # consensus view for eval/checkpoint (mask-weighted aggregation)
@@ -1801,15 +1840,9 @@ class FedEngine:
         # unmerged deltas, and the residual re-delivers only compression
         # error (no update mass is ever applied twice).
         scales = self.faults.transport_scales(rnd)
-        auth = None
-        if self._comp is None:
-            sent = self._transport(stacked, scales)
-            sent_p = None
-            if self.ledger is not None:
-                auth = self._ledger_verify(rnd, stacked, sent)
-        else:
-            sent_p, _, auth = self._compressed_exchange(
-                rnd, stacked, base, rngs, scales, mode="async")
+        ex = self._exchange_updates(rnd, stacked, base, rngs, scales,
+                                    mode="async")
+        auth = ex.auth
         if auth is not None:
             rec.auth = auth.tolist()
             mask = mask * auth
@@ -1835,8 +1868,8 @@ class FedEngine:
             alpha = alpha * n_ex
 
         if arrived:
-            deltas = (_tree_sub(sent, base) if self._comp is None
-                      else self.progs.decode_delta(sent_p, stacked))
+            deltas = (_tree_sub(ex.sent, base) if self._comp is None
+                      else self.progs.decode_delta(ex.sent, stacked))
             zero = jax.tree.map(jnp.zeros_like, trainable)
             # collapse is a weight-NORMALIZED mean (divides by sum(alpha)), so
             # on its own the staleness decay would cancel out of the update

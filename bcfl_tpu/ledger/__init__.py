@@ -1,4 +1,10 @@
-from bcfl_tpu.ledger.ledger import Ledger, LedgerEntry, params_digest  # noqa: F401
+from bcfl_tpu.ledger.ledger import (  # noqa: F401
+    GENESIS,
+    Ledger,
+    LedgerEntry,
+    chain_extend,
+    params_digest,
+)
 from bcfl_tpu.ledger.fingerprint import (  # noqa: F401
     client_fingerprint,
     entry_digest,

@@ -41,6 +41,18 @@ from bcfl_tpu.native.build import load_ledger_lib
 GENESIS = b"\x00" * 32
 
 
+def chain_extend(prev: bytes, payload: bytes, use_native: bool = True) -> bytes:
+    """One chain link: ``H(prev || payload)`` (C++ core when built)."""
+    lib = load_ledger_lib() if use_native else None
+    if lib is not None:
+        import ctypes
+
+        out = ctypes.create_string_buffer(32)
+        lib.bcfl_chain_extend(prev, payload, len(payload), out)
+        return out.raw
+    return hashlib.sha256(prev + payload).digest()
+
+
 def _leaf_bytes(path, leaf) -> Tuple[bytes, bytes]:
     name = "/".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
     arr = np.asarray(leaf)
@@ -114,14 +126,7 @@ class Ledger:
         return len(self.entries)
 
     def _extend(self, prev: bytes, payload: bytes) -> bytes:
-        lib = load_ledger_lib() if self.use_native else None
-        if lib is not None:
-            import ctypes
-
-            out = ctypes.create_string_buffer(32)
-            lib.bcfl_chain_extend(prev, payload, len(payload), out)
-            return out.raw
-        return hashlib.sha256(prev + payload).digest()
+        return chain_extend(prev, payload, self.use_native)
 
     def append(self, round_idx: int, client: int, tree,
                payload_bytes: Optional[int] = None) -> LedgerEntry:
@@ -176,6 +181,118 @@ class Ledger:
             if e.round == round_idx and e.client == client:
                 return e.params_digest == digest
         return False
+
+    # ------------------------------------------------------------ fork/merge
+    # A real network partition (RUNTIME.md) leaves each connected component
+    # extending its own copy of the chain from a common prefix — a genuine
+    # fork. The heal protocol is: exchange heads -> locate the fork point
+    # (longest common prefix) -> exchange the divergent SEGMENTS -> verify
+    # each received segment link by link against the fork-point head ->
+    # adopt the deterministic merge (both sides re-chain the union in one
+    # canonical order, so the merged chain is identical on every peer and
+    # verifies end to end).
+
+    def head_at(self, n: int) -> bytes:
+        """Chain head after the first ``n`` entries (GENESIS at 0)."""
+        if n < 0 or n > len(self.heads):
+            raise ValueError(f"head_at({n}) out of range [0, {len(self.heads)}]")
+        return GENESIS if n == 0 else self.heads[n - 1]
+
+    def fork_point(self, other_heads: List[bytes]) -> int:
+        """Length of the longest common prefix with another chain's head
+        list — the index both chains agree up to (0 = they share only
+        genesis)."""
+        n = 0
+        for mine, theirs in zip(self.heads, other_heads):
+            if mine != theirs:
+                break
+            n += 1
+        return n
+
+    def segment(self, start: int) -> List[Dict]:
+        """JSON-able rows for entries ``[start:]`` (entry fields + the head
+        after each link) — what one side of a fork ships to the other."""
+        return [
+            {"round": e.round, "client": e.client,
+             "digest": e.params_digest.hex(),
+             "payload_bytes": e.payload_bytes,
+             "head": self.heads[start + i].hex()}
+            for i, e in enumerate(self.entries[start:])
+        ]
+
+    @staticmethod
+    def verify_segment(prev_head: bytes, rows: List[Dict],
+                       use_native: bool = True) -> int:
+        """Recompute every link of a received segment against the shared
+        fork-point head: -1 if the segment's claimed heads all check out,
+        else the index (within the segment) of the first bad link. A
+        tampered entry OR a tampered claimed head both fail here — the
+        receiving component never adopts an unverifiable fork."""
+        prev = prev_head
+        for i, row in enumerate(rows):
+            entry = LedgerEntry(int(row["round"]), int(row["client"]),
+                                bytes.fromhex(row["digest"]),
+                                int(row["payload_bytes"]))
+            h = chain_extend(prev, entry.serialize(), use_native)
+            if h != bytes.fromhex(row["head"]):
+                return i
+            prev = h
+        return -1
+
+    @staticmethod
+    def merge_rows(*segments: List[Dict]) -> List[Dict]:
+        """Deterministic union of divergent fork segments: rows sorted by
+        ``(round, client, digest)`` with exact duplicates dropped. Every
+        peer computes the same order from the same segments, so re-chaining
+        the merge yields identical heads everywhere — the consensus head."""
+        seen = set()
+        out = []
+        # the sort key is the FULL row identity (incl. payload_bytes):
+        # rows tied on (round, client, digest) but differing in
+        # payload_bytes would otherwise keep input-dependent stable-sort
+        # order and the two sides would re-chain different heads
+        for row in sorted(
+                (r for seg in segments for r in seg),
+                key=lambda r: (int(r["round"]), int(r["client"]),
+                               r["digest"], int(r["payload_bytes"]))):
+            key = (int(row["round"]), int(row["client"]), row["digest"],
+                   int(row["payload_bytes"]))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(row)
+        return out
+
+    def adopt_merge(self, fork_base: int, merged_rows: List[Dict]) -> None:
+        """Replace everything after ``fork_base`` with the merged segment,
+        re-chaining from the fork-point head. After this, both sides of the
+        heal hold byte-identical chains (``verify_chain() == -1``)."""
+        if fork_base > len(self.entries):
+            raise ValueError(
+                f"fork_base {fork_base} beyond chain length "
+                f"{len(self.entries)}")
+        del self.entries[fork_base:]
+        del self.heads[fork_base:]
+        for row in merged_rows:
+            self.append_digest(int(row["round"]), int(row["client"]),
+                               bytes.fromhex(row["digest"]),
+                               int(row["payload_bytes"]))
+
+    def append_rows(self, rows: List[Dict]) -> int:
+        """Append already-chained rows (a replica catching up from its
+        leader), verifying each link as it lands: returns -1 on success or
+        the index of the first row whose claimed head does not extend this
+        chain."""
+        for i, row in enumerate(rows):
+            entry = LedgerEntry(int(row["round"]), int(row["client"]),
+                                bytes.fromhex(row["digest"]),
+                                int(row["payload_bytes"]))
+            h = self._extend(self.head, entry.serialize())
+            if h != bytes.fromhex(row["head"]):
+                return i
+            self.heads.append(h)
+            self.entries.append(entry)
+        return -1
 
     def payload_accounting(self) -> Dict[str, float]:
         """Ledger-vs-full-weights communication sizes (GB), the quantity the

@@ -76,6 +76,12 @@ INIT_TIMEOUT_S = float(os.environ.get("BCFL_BENCH_INIT_TIMEOUT_S", "300"))
 # tunnelled init (20-40 s); an explicit BCFL_BENCH_INIT_TIMEOUT_S still
 # governs init (it becomes the preflight deadline) since init now happens
 # here, not under the import-stage INIT_TIMEOUT_S.
+# NOTE: the probe + this env precedence are mirrored by
+# bcfl_tpu.core.hostenv.backend_preflight (the driver scripts' preflight —
+# run_results/tpu_perf/worker_pair). bench keeps its own inline copy
+# because its contract is an error JSON LINE via the staged watchdog, and
+# nothing here may import the package before that watchdog is armed; if
+# you change the deadline policy or the probe, change both.
 PREFLIGHT_TIMEOUT_S = float(os.environ.get(
     "BCFL_BENCH_PREFLIGHT_S",
     os.environ.get("BCFL_BENCH_INIT_TIMEOUT_S", "90")))

@@ -125,4 +125,19 @@ print("  unique clients touched: %d; server_round traces: %d (pinned 1)"
       % (len(seen), eng.progs.server_round._cache_size()))
 assert eng.progs.server_round._cache_size() == 1, "per-round retrace!"
 EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Dist-partition leg (RUNTIME.md): the REAL multi-process async runtime
+# under a socket-level partition — two peer OS processes, the ledger chain
+# genuinely forks per connected component, the heal reconciles it with a
+# segment-verified deterministic merge, and the measured (arrival-order)
+# staleness distribution is recorded. Hard deadlines + orphan reaping
+# throughout: a hung peer fails this leg, it cannot wedge the script.
+echo
+echo "dist-partition leg: 2 peers, partition rounds 2:4, fork + heal"
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/dist_async.py --peers 2 --rounds 6 --partition 2:4 \
+    --no-kill --compress none --deadline 400 --idle-timeout 90 \
+    --out /tmp/bcfl_chaos_dist_async.json
 exit $?

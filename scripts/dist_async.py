@@ -1,0 +1,229 @@
+"""Dist-runtime proof driver: real peers, measured staleness, a real fork.
+
+Runs the multi-process async P2P runtime (``FedConfig.runtime="dist"``,
+RUNTIME.md) end to end on CPU loopback and writes
+``results/dist_async.json`` with the three pieces of evidence the runtime
+exists to produce:
+
+(a) a nonzero MEASURED staleness distribution — arrival-order staleness
+    from the FedBuff merges, not a simulated clock,
+(b) a partition round where the ledger chain genuinely FORKS — the two
+    connected components extend distinct heads, both recorded,
+(c) a post-heal reconcile — segment-verified deterministic chain merge +
+    consensus model — after which the merged chain verifies end to end.
+
+By default it also runs the crash/rejoin leg: peer 1 is SIGKILLed as soon
+as its first checkpoint lands and restarted with ``--resume``; the run must
+still complete (the restarted peer restores from the checkpoint and re-
+enters via the HELLO handshake).
+
+Everything runs under hard deadlines (per-peer in-process watchdogs + the
+supervisor's wall deadline + an orphan reaper): a hung peer FAILS the run,
+it cannot wedge it.
+
+Usage: python scripts/dist_async.py [--peers 2] [--rounds 8]
+           [--partition 2:4 | --no-partition] [--no-kill]
+           [--compress int8+topk] [--deadline 600] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_cfg(args):
+    from bcfl_tpu.compression import CompressionConfig
+    from bcfl_tpu.config import DistConfig, FedConfig, LedgerConfig, PartitionConfig
+    from bcfl_tpu.faults import FaultPlan
+
+    # the straggler lane applies with or without a partition (it is a real
+    # pre-send sleep at the transport — the injected part of the measured
+    # staleness distribution)
+    plan_kw = dict(straggler_prob=args.straggler_prob,
+                   straggler_delay_s=args.straggler_delay_s)
+    if args.partition:
+        lo, hi = (int(x) for x in args.partition.split(":"))
+        # components over PEERS: peer 0's half vs the rest — the 2-peer
+        # default is ((0,), (1,)), a genuine 2-way split
+        half = max(args.peers // 2, 1)
+        plan_kw.update(
+            partition_groups=(tuple(range(half)),
+                              tuple(range(half, args.peers))),
+            partition_rounds=tuple(range(lo, hi)))
+    plan = FaultPlan(**plan_kw)
+    return FedConfig(
+        name="dist_async", runtime="dist", mode="server", sync="async",
+        model=args.model, dataset="synthetic",
+        num_clients=args.clients, num_rounds=args.rounds,
+        seq_len=args.seq_len, batch_size=args.batch_size,
+        max_local_batches=2, eval_every=0, seed=args.seed,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        compression=CompressionConfig(kind=args.compress),
+        faults=plan,
+        dist=DistConfig(
+            peers=args.peers, buffer=args.buffer,
+            buffer_timeout_s=10.0,
+            idle_timeout_s=args.idle_timeout,
+            peer_deadline_s=args.deadline,
+            checkpoint_every_versions=1),
+        checkpoint_dir=None,
+    )
+
+
+def analyze(result, cfg, partitioned: bool, killed) -> dict:
+    """Reduce the per-peer reports to the proof record + pass/fail gates."""
+    reports = result["reports"]
+    peers = cfg.dist.peers
+    gates = {}
+    staleness = []
+    for rep in reports.values():
+        staleness.extend(rep.get("staleness_values") or [])
+    latencies = [x for rep in reports.values()
+                 for x in (rep.get("arrival_latency_s") or [])]
+    gates["all_peers_completed"] = (
+        result["ok"] and len(reports) == peers)
+    gates["staleness_measured_nonzero"] = any(s > 0 for s in staleness)
+    hist = {}
+    for s in staleness:
+        hist[str(s)] = hist.get(str(s), 0) + 1
+
+    fork_rec = None
+    reconcile = None
+    if partitioned:
+        leader = reports.get(0, {})
+        follower_ids = [p for p in range(1, peers) if p in reports]
+        reconcile = leader.get("reconcile")
+        heads = {p: (reports[p].get("fork") or {}).get("head_before_heal")
+                 for p in [0] + follower_ids if reports.get(p, {}).get("fork")}
+        solo = {p: reports[p].get("solo_merges", 0) for p in reports}
+        fork_rec = {
+            "components_heads_before_heal": heads,
+            "solo_merges": solo,
+            "reconcile": reconcile,
+        }
+        distinct = len(set(h for h in heads.values() if h)) >= 2
+        gates["ledger_forked_two_heads"] = bool(
+            distinct and reconcile and reconcile.get("forked"))
+        gates["reconcile_merged_chain_verifies"] = bool(
+            reconcile and reconcile.get("chain_ok")
+            and reconcile.get("segment_rejected_at") is None)
+        final_heads = {p: reports[p].get("chain_head") for p in reports}
+        gates["post_heal_heads_agree"] = (
+            len(set(final_heads.values())) == 1)
+    gates["chains_verify"] = all(
+        rep.get("chain_ok") in (True, None) for rep in reports.values())
+    if killed is not None:
+        rep = reports.get(killed, {})
+        gates["killed_peer_resumed_from_checkpoint"] = bool(
+            rep.get("resumed")) and rep.get("status") == "ok"
+
+    return {
+        "proof": "dist_async",
+        "process_count": result["process_count"],
+        "peers": peers,
+        "clients": cfg.num_clients,
+        "target_versions": cfg.num_rounds,
+        "compress": cfg.compression.kind,
+        "final_versions": {p: r.get("final_version")
+                          for p, r in reports.items()},
+        "staleness_distribution": hist,
+        "staleness_samples": len(staleness),
+        "arrival_latency_s": {
+            "n": len(latencies),
+            "mean": (sum(latencies) / len(latencies)) if latencies else None,
+            "max": max(latencies) if latencies else None,
+        },
+        "fork": fork_rec,
+        "kill": result.get("kill"),
+        "final_eval": reports.get(0, {}).get("final_eval"),
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="global model versions the leader must produce")
+    ap.add_argument("--model", default="tiny-bert")
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--compress", default="int8+topk",
+                    choices=["none", "int8", "topk", "int8+topk"])
+    ap.add_argument("--buffer", type=int, default=0,
+                    help="peer updates per merge (0 = 1, pure async)")
+    ap.add_argument("--partition", default="2:4", metavar="START:END",
+                    help="local-round span the transport partition lasts "
+                         "(half-open); '' disables")
+    ap.add_argument("--no-partition", dest="partition", action="store_const",
+                    const="")
+    ap.add_argument("--straggler-prob", type=float, default=0.3)
+    ap.add_argument("--straggler-delay-s", type=float, default=0.5)
+    ap.add_argument("--kill-peer", type=int, default=1,
+                    help="SIGKILL this peer once its first checkpoint "
+                         "lands, then restart it with --resume")
+    ap.add_argument("--no-kill", dest="kill_peer", action="store_const",
+                    const=-1)
+    ap.add_argument("--deadline", type=float, default=600.0)
+    ap.add_argument("--idle-timeout", type=float, default=120.0)
+    ap.add_argument("--platform", default=os.environ.get("JAX_PLATFORMS")
+                    or "cpu")
+    ap.add_argument("--run-dir", default=None)
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT, "results",
+                                                  "dist_async.json"))
+    args = ap.parse_args(argv)
+
+    from bcfl_tpu.dist.harness import run_dist
+
+    cfg = build_cfg(args)
+    run_dir = args.run_dir or os.path.join("/tmp", f"bcfl_dist_{os.getpid()}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    kill = args.kill_peer if 0 <= args.kill_peer < args.peers else None
+    print(f"dist_async: {args.peers} peers x "
+          f"{args.clients // args.peers} clients, target "
+          f"{args.rounds} versions, partition="
+          f"{args.partition or 'off'}, kill_peer={kill}, "
+          f"compress={args.compress}; run dir {run_dir}", flush=True)
+
+    t0 = time.time()
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform, kill_peer=kill)
+    record = analyze(result, cfg, partitioned=bool(args.partition),
+                     killed=kill)
+    record["recorded_at"] = int(time.time())
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps({k: v for k, v in record.items()
+                      if k in ("gates", "staleness_distribution",
+                               "final_versions", "wall_s", "ok")},
+                     indent=2), flush=True)
+    if not record["ok"]:
+        for p, tail in result["log_tails"].items():
+            print(f"--- peer {p} log tail ---\n{tail}", flush=True)
+        print(f"dist_async FAILED (evidence in {args.out}; logs in "
+              f"{run_dir})", flush=True)
+        return 1
+    print(f"dist_async OK in {time.time() - t0:.1f}s -> {args.out}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

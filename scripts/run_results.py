@@ -109,7 +109,10 @@ def main(argv=None):
         _render(args, summary, accuracy_curves)
         return
 
-    from bcfl_tpu.core.hostenv import raise_cpu_collective_timeouts
+    from bcfl_tpu.core.hostenv import (
+        backend_preflight,
+        raise_cpu_collective_timeouts,
+    )
 
     raise_cpu_collective_timeouts()
 
@@ -117,6 +120,10 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    # fail fast on a wedged TPU tunnel (bench.py's preflight, ROADMAP
+    # BENCH_r03-r05): hours-long silent init hangs become a ~90 s exit 3
+    backend_preflight()
 
     from bcfl_tpu.config import LedgerConfig, PartitionConfig, TopologyConfig
     from bcfl_tpu.entrypoints.presets import get_preset

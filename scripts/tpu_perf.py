@@ -610,6 +610,13 @@ def main(argv=None):
         # site hooks on some hosts, so bench.py honors this explicit knob
         os.environ["BCFL_BENCH_PLATFORM"] = args.platform
 
+    # fail fast on a wedged TPU tunnel (bench.py's preflight, ROADMAP
+    # BENCH_r03-r05 "stage made no progress"): prove the backend alive
+    # under its own short deadline before the staged run commits
+    from bcfl_tpu.core.hostenv import backend_preflight
+
+    backend_preflight()
+
     WATCHDOG.stage("backend-init", 300.0)
     import jax
 

@@ -64,6 +64,12 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", args.platform)
 
+    # fail fast on a wedged TPU tunnel (bench.py's preflight, ROADMAP
+    # BENCH_r03-r05): hours-long silent init hangs become a ~90 s exit 3
+    from bcfl_tpu.core.hostenv import backend_preflight
+
+    backend_preflight()
+
     from bcfl_tpu.entrypoints.presets import get_preset
     from bcfl_tpu.entrypoints.run import run
 

@@ -32,3 +32,21 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # the checkout under test must always win over any installed copy of the
 # package (a stale non-editable `pip install .` would otherwise shadow it)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _reap_dist_peers():
+    """Orphan reaper for the dist runtime (RUNTIME.md §5): any peer
+    subprocess a dist test spawned and failed to collect — a hung peer, an
+    interrupted harness — is SIGKILLed at session teardown, so a straggler
+    can never squat on the tier-1 870 s window or outlive the CI job. The
+    peers also self-destruct (in-process deadline + parent-death watchdogs);
+    this is the belt to those suspenders."""
+    yield
+    from bcfl_tpu.dist.harness import reap_all
+
+    killed = reap_all()
+    if killed:
+        print(f"\n[conftest] reaped {killed} straggler dist peer(s)")

@@ -1,17 +1,25 @@
-"""2-process DCN execution (VERDICT r03 #4): spawns two real JAX processes
-with a local coordinator and runs one cross-host federated round. This is
-the only test that observes ``jax.process_count() == 2``.
+"""Two-process execution proofs.
 
-A cheap 2-process probe runs first: some jaxlib CPU backends accept
-``jax.distributed.initialize`` and then refuse to EXECUTE cross-process
-computations ("Multiprocess computations aren't implemented on the CPU
-backend" — this host's jaxlib 0.4.x does exactly that), which used to fail
-this test hard in the slow tier (ROADMAP open item). The probe compiles one
-tiny cross-process reduction; if the backend can't run it, the test SKIPS
-with the backend's own error as the reason instead of failing on a known
-platform gap. On a backend with real multiprocess support (TPU pod, or a
-jaxlib whose CPU collectives work) the probe passes and the full proof
-runs."""
+Two distinct multi-process capabilities live here:
+
+1. ``test_two_process_fed_round`` (slow tier) — the ``jax.distributed``
+   DCN proof: two JAX processes, one coordinator, one cross-host GSPMD
+   federated round. A cheap probe runs first: some jaxlib CPU backends
+   accept ``jax.distributed.initialize`` and then refuse to EXECUTE
+   cross-process computations ("Multiprocess computations aren't
+   implemented on the CPU backend" — this host's jaxlib 0.4.x does exactly
+   that); there the probe skips the test with the backend's own error.
+
+2. ``test_dist_loopback_two_peers`` (tier-1, marker ``dist``) — the REAL
+   multi-process async runtime's loopback harness (bcfl_tpu.dist,
+   RUNTIME.md): two peer OS processes exchanging updates over TCP with
+   buffered async aggregation and measured staleness. This one runs on
+   EVERY backend — the jax.distributed CPU gap doesn't apply, because the
+   peers are independent single-process JAX runtimes and the cross-process
+   hop is the runtime's own transport. CPU CI therefore now OBSERVES
+   ``process_count == 2`` on every run instead of skipping (the parent
+   enforces a hard deadline and reaps stragglers; a hung peer fails the
+   test, it cannot wedge the 870 s window)."""
 
 import json
 import os
@@ -20,10 +28,6 @@ import sys
 import textwrap
 
 import pytest
-
-pytestmark = pytest.mark.slow  # engine-suite tier: compile-heavy on the
-# 8-device CPU mesh; the tier-1 'not slow' window runs the chaos matrix
-# (tests/test_faults.py) as its fast engine coverage instead
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -87,6 +91,7 @@ def _multiprocess_probe():
     return False, tail[-300:]
 
 
+@pytest.mark.slow  # compile-heavy (full model on an 8-device mesh twice)
 def test_two_process_fed_round():
     supported, reason = _multiprocess_probe()
     if not supported:
@@ -106,3 +111,41 @@ def test_two_process_fed_round():
     assert proof["process_count"] == 2
     assert proof["hosts_major_order"] == sorted(proof["hosts_major_order"])
     assert proof["round_examples"] > 0
+
+
+@pytest.mark.dist
+def test_dist_loopback_two_peers(tmp_path):
+    """Tier-1 2-peer smoke of the dist runtime's loopback harness: two real
+    peer processes complete a buffered-async federation under a hard
+    deadline, the measured staleness distribution is nonzero, and both
+    chain replicas verify. This is CPU CI's standing observation of
+    ``process_count == 2`` (the jax.distributed proof above needs a backend
+    with cross-process collectives; this needs only TCP loopback)."""
+    from bcfl_tpu.config import DistConfig, FedConfig, LedgerConfig, PartitionConfig
+    from bcfl_tpu.dist.harness import run_dist
+
+    cfg = FedConfig(
+        name="dist_smoke", runtime="dist", mode="server", sync="async",
+        model="tiny-bert", dataset="synthetic", num_clients=4, num_rounds=3,
+        seq_len=16, batch_size=4, max_local_batches=2, eval_every=0,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        dist=DistConfig(peers=2, buffer_timeout_s=5.0, idle_timeout_s=60.0,
+                        peer_deadline_s=150.0, checkpoint_every_versions=0))
+    result = run_dist(cfg, str(tmp_path / "run"), deadline_s=170.0,
+                      platform="cpu")
+    assert result["process_count"] == 2
+    assert result["returncodes"] == {"0": 0, "1": 0}, result["log_tails"]
+    assert result["ok"], result["log_tails"]
+    reports = result["reports"]
+    assert all(reports[p]["status"] == "ok" for p in (0, 1))
+    assert all(reports[p]["final_version"] >= cfg.num_rounds for p in (0, 1))
+    # the staleness distribution is MEASURED (arrival order), and with
+    # merge-on-arrival the concurrent follower is genuinely stale
+    staleness = [s for p in (0, 1)
+                 for s in reports[p]["staleness_values"]]
+    assert staleness and any(s > 0 for s in staleness), staleness
+    # every peer's chain replica verifies, and the replicas agree
+    assert all(reports[p]["chain_ok"] for p in (0, 1))
+    assert reports[0]["chain_head"] == reports[1]["chain_head"]
+    assert reports[0]["final_eval"] is not None

@@ -141,6 +141,100 @@ def test_struct_and_entry_digest():
     assert entry_digest(s, fp, use_native=False) == entry_digest(s, fp, True)
 
 
+# ------------------------------ fork / merge (dist runtime, RUNTIME.md) -----
+
+
+def _forked_pair(k=3, prefix=4):
+    """Two chains sharing a ``prefix``-entry history, then diverging by
+    ``k`` entries each — what a real transport partition produces."""
+    a = Ledger()
+    for i in range(prefix):
+        a.append(0, i, _tree(i))
+    b = Ledger.from_json(a.to_json())
+    for i in range(k):
+        a.append(1 + i, 0, _tree(100 + i))  # component {0} extends its fork
+        b.append(1 + i, 1, _tree(200 + i))  # component {1} extends its own
+    return a, b, prefix
+
+
+def test_fork_point_and_distinct_heads():
+    a, b, prefix = _forked_pair()
+    assert a.head != b.head  # a REAL fork: two distinct heads
+    assert a.fork_point(b.heads) == prefix
+    assert b.fork_point(a.heads) == prefix
+    # both forks are internally valid chains
+    assert a.verify_chain() == -1 and b.verify_chain() == -1
+
+
+def test_merge_reconciles_to_one_consensus_head():
+    a, b, prefix = _forked_pair(k=3)
+    fork = a.fork_point(b.heads)
+    seg_a, seg_b = a.segment(fork), b.segment(fork)
+    # each side verifies the OTHER's segment against the shared fork head
+    assert Ledger.verify_segment(a.head_at(fork), seg_b) == -1
+    assert Ledger.verify_segment(b.head_at(fork), seg_a) == -1
+    merged = Ledger.merge_rows(seg_a, seg_b)
+    assert len(merged) == 6  # disjoint forks: union keeps everything
+    a.adopt_merge(fork, merged)
+    b.adopt_merge(fork, merged)
+    # consensus: identical heads on both sides, chain verifies end to end
+    assert a.head == b.head
+    assert a.verify_chain() == -1 and b.verify_chain() == -1
+    assert len(a) == prefix + 6
+
+
+def test_tampered_segment_rejected_on_either_side():
+    a, b, _ = _forked_pair(k=2)
+    fork = a.fork_point(b.heads)
+    seg = b.segment(fork)
+    tampered = [dict(r) for r in seg]
+    tampered[1]["digest"] = "ff" * 32  # entry tampered in flight
+    assert Ledger.verify_segment(a.head_at(fork), tampered) == 1
+    heads_tampered = [dict(r) for r in seg]
+    heads_tampered[0]["head"] = "ee" * 32  # claimed head tampered
+    assert Ledger.verify_segment(a.head_at(fork), heads_tampered) == 0
+    # the honest segment still verifies (the reject is not over-eager)
+    assert Ledger.verify_segment(a.head_at(fork), seg) == -1
+
+
+def test_merge_rows_deterministic_and_dedups():
+    a, b, _ = _forked_pair(k=2)
+    fork = a.fork_point(b.heads)
+    seg_a, seg_b = a.segment(fork), b.segment(fork)
+    m1 = Ledger.merge_rows(seg_a, seg_b)
+    m2 = Ledger.merge_rows(seg_b, seg_a)  # order-independent
+    strip = lambda rows: [  # noqa: E731
+        {k: v for k, v in r.items() if k != "head"} for r in rows]
+    assert strip(m1) == strip(m2)
+    assert strip(Ledger.merge_rows(seg_a, seg_a)) == strip(
+        Ledger.merge_rows(seg_a))  # exact duplicates collapse
+
+
+def test_merge_rows_tie_on_digest_stays_deterministic():
+    # rows equal in (round, client, digest) but differing in payload_bytes
+    # must merge in one canonical order regardless of argument order —
+    # otherwise the two sides of a heal would re-chain different heads
+    a = {"round": 1, "client": 0, "digest": "ab" * 32,
+         "payload_bytes": 10, "head": "00" * 32}
+    b = dict(a, payload_bytes=20)
+    m1 = Ledger.merge_rows([a], [b])
+    m2 = Ledger.merge_rows([b], [a])
+    assert m1 == m2 and len(m1) == 2
+    assert [r["payload_bytes"] for r in m1] == [10, 20]
+
+
+def test_append_rows_replicates_and_rejects_bad_link():
+    a = Ledger()
+    for i in range(3):
+        a.append(0, i, _tree(i))
+    replica = Ledger()
+    assert replica.append_rows(a.segment(0)) == -1
+    assert replica.head == a.head and replica.verify_chain() == -1
+    bad = a.segment(0)
+    bad[1]["head"] = "aa" * 32
+    assert Ledger().append_rows(bad) == 1
+
+
 def test_append_digest_and_authenticate_digest():
     led = Ledger()
     d = hashlib.sha256(b"update").digest()

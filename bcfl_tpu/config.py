@@ -127,6 +127,40 @@ class DistConfig:
     # checkpoint every N adopted/produced global versions (0 = off); the
     # crash/rejoin path restores from the newest one
     checkpoint_every_versions: int = 1
+    # --- self-healing transport policy (RUNTIME.md "Delivery contract") ---
+    # every logical send retries failed attempts with exponential backoff
+    # (base * 2^k, capped at retry_max_s, deterministically jittered) up to
+    # send_retries RE-tries, all under the per-destination send_deadline_s
+    # wall budget — at-least-once delivery, made safe by the receiver's
+    # per-sender (from, msg_id) dedup window
+    send_retries: int = 4
+    retry_base_s: float = 0.05
+    retry_max_s: float = 2.0
+    send_deadline_s: float = 20.0
+    # circuit-breaker failure detector: consecutive send-attempt failures
+    # move a peer REACHABLE -> SUSPECT (suspect_after) -> DOWN
+    # (down_after); any success snaps it back to REACHABLE. While DOWN the
+    # circuit is open — sends are skipped except one probe per
+    # probe_interval_s, so a recovered peer is re-detected without paying
+    # a connect timeout on every message
+    suspect_after: int = 2
+    down_after: int = 6
+    probe_interval_s: float = 2.0
+    # receiver-side per-sender dedup window (message ids); ids at or below
+    # (newest seen - window) are treated as duplicates and dropped
+    dedup_window: int = 1024
+    # bounded inbox: a flooding (or chaos-duplicated) peer cannot grow a
+    # leader's queue without bound — overflow REFUSES the newest frame
+    # (no ack, dedup id un-recorded, counted in transport stats
+    # `inbox_overflow`), so the sender's retry can still deliver it once
+    # the inbox drains — at-least-once survives a full inbox
+    inbox_max: int = 1024
+    # quorum degradation: the FedBuff leader's buffer target counts only
+    # component peers the detector does NOT hold DOWN (merges recorded as
+    # degraded while any are), and below this reachable fraction of the
+    # component the leader refuses to advance the global at all (the idle
+    # watchdog bounds that wait)
+    quorum_frac: float = 0.5
 
     def __post_init__(self):
         if self.peers < 2:
@@ -137,9 +171,26 @@ class DistConfig:
                 f"dist buffer {self.buffer} must be in [0, peers="
                 f"{self.peers}] (it counts buffered PEER updates)")
         for name in ("buffer_timeout_s", "idle_timeout_s",
-                     "peer_deadline_s"):
+                     "peer_deadline_s", "retry_base_s", "retry_max_s",
+                     "send_deadline_s", "probe_interval_s"):
             if getattr(self, name) <= 0:
                 raise ValueError(f"{name} must be > 0")
+        if self.send_retries < 0:
+            raise ValueError(
+                f"send_retries must be >= 0, got {self.send_retries}")
+        if self.suspect_after < 1:
+            raise ValueError(
+                f"suspect_after must be >= 1, got {self.suspect_after}")
+        if self.down_after < self.suspect_after:
+            raise ValueError(
+                f"down_after {self.down_after} must be >= suspect_after "
+                f"{self.suspect_after} (a peer is SUSPECT before DOWN)")
+        for name in ("dedup_window", "inbox_max"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if not 0.0 < self.quorum_frac <= 1.0:
+            raise ValueError(
+                f"quorum_frac must be in (0, 1], got {self.quorum_frac}")
 
 
 # --- runtime capability table (RUNTIME.md §2) --------------------------------
@@ -244,10 +295,18 @@ RUNTIME_CAPS: Tuple = (
     ("chaos: transport corruption / flaky bursts",
      lambda c: c.faults.corrupts,
      {"local": True,
-      "dist": "injected corruption of the real TCP payload is not "
-              "implemented (the ledger verify path would catch genuine "
-              "wire damage; simulated damage needs a tap the transport "
-              "does not expose yet)"}),
+      "dist": "per-client corruption scales act on the engine's stacked "
+              "in-graph transport stage, which dist rounds never run; use "
+              "the wire lane instead (wire_corrupt_prob flips real frame "
+              "bytes in flight; the frame CRC and the ledger verify path "
+              "catch them)"}),
+    ("chaos: wire faults (drop/dup/reorder/delay/corrupt)",
+     lambda c: c.faults.wire_enabled,
+     {"local": "the local engine has no socket boundary to inject at — "
+               "the wire lane acts on real TCP frames in the dist "
+               "transport (PeerTransport); use corrupt_prob for the "
+               "simulated-transport analogue",
+      "dist": True}),
     ("chaos: churn",
      lambda c: c.faults.churns,
      {"local": True,

@@ -10,11 +10,17 @@ connected component and the heal reconciles the forks. See RUNTIME.md.
 
 from bcfl_tpu.dist.harness import free_ports, reap_all, run_dist
 from bcfl_tpu.dist.launch import cfg_from_json, cfg_to_json
-from bcfl_tpu.dist.transport import PartitionGate, PeerTransport, TransportError
+from bcfl_tpu.dist.transport import (
+    FailureDetector,
+    PartitionGate,
+    PeerTransport,
+    TransportError,
+    WireChaos,
+)
 from bcfl_tpu.dist.wire import pack_frame, read_frame, unpack_frame
 
 __all__ = [
-    "PartitionGate", "PeerTransport", "TransportError",
-    "cfg_from_json", "cfg_to_json", "free_ports", "pack_frame",
+    "FailureDetector", "PartitionGate", "PeerTransport", "TransportError",
+    "WireChaos", "cfg_from_json", "cfg_to_json", "free_ports", "pack_frame",
     "read_frame", "reap_all", "run_dist", "unpack_frame",
 ]

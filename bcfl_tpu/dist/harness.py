@@ -1,4 +1,4 @@
-"""Spawn/supervise/reap the peer processes (RUNTIME.md §5).
+"""Spawn/supervise/reap the peer processes (RUNTIME.md §7).
 
 The supervisor side of the dist runtime: write the config JSON, pick free
 ports, spawn one ``python -m bcfl_tpu.dist`` subprocess per peer, enforce a
@@ -99,12 +99,20 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
              platform: Optional[str] = None,
              kill_peer: Optional[int] = None,
              kill_after_version: int = 1,
-             restart_delay_s: float = 2.0) -> Dict:
+             restart_delay_s: float = 2.0,
+             restart_killed: bool = True) -> Dict:
     """Run one full dist federation: spawn ``cfg.dist.peers`` peer
     processes, supervise them under a hard deadline, optionally SIGKILL
     ``kill_peer`` mid-run once its checkpoint has reached
     ``kill_after_version`` and restart it with ``--resume`` (the
     crash/rejoin leg), and collect the per-peer reports.
+
+    ``restart_killed=False`` leaves the killed peer dead — the quorum-
+    degradation leg (``scripts/dist_chaos.py``): the survivors' failure
+    detectors must mark it DOWN and the leader must complete the run on
+    the reachable quorum instead of stalling. The overall ``ok`` is False
+    by construction there (the corpse's returncode and missing report);
+    that leg's caller grades the survivors' reports instead.
 
     Returns ``{"ok", "returncodes", "reports", "run_dir", ...}``; raises
     nothing on peer failure — the caller inspects the result (and the logs
@@ -145,12 +153,16 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
                 getattr(proc, "_bcfl_log", None) and proc._bcfl_log.close()
                 kill_record = {"peer": kill_peer,
                                "killed_at_s": time.time() - t0,
-                               "checkpoint_seen": ckpt}
-                time.sleep(restart_delay_s)
-                procs[kill_peer] = spawn_peer(
-                    cfg_path, kill_peer, ports, run_dir, resume=True,
-                    platform=platform)
-                rcs[kill_peer] = None
+                               "checkpoint_seen": ckpt,
+                               "restarted": restart_killed}
+                if restart_killed:
+                    time.sleep(restart_delay_s)
+                    procs[kill_peer] = spawn_peer(
+                        cfg_path, kill_peer, ports, run_dir, resume=True,
+                        platform=platform)
+                    rcs[kill_peer] = None
+                else:
+                    rcs[kill_peer] = proc.returncode
                 killed_restarted = True
         if all(rc is not None for rc in rcs.values()):
             break

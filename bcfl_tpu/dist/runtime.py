@@ -57,10 +57,12 @@ logger = logging.getLogger(__name__)
 class MergeRecord:
     version: int
     leader: int
-    arrivals: List[Dict]  # per merged update: peer/staleness/latency/auth
+    arrivals: List[Dict]  # per merged update: peer/msg_id/staleness/latency/auth
     rejected: List[Dict]  # updates excluded (stale lineage, auth failure)
     wall_s: float
     solo: bool  # produced while partitioned (a fork extension)
+    degraded: bool = False  # merged on a reduced quorum (some peer DOWN)
+    quorum: Optional[Dict] = None  # {"component", "alive", "down"} when degraded
 
 
 def _peer_engine_cfg(cfg, local_clients: int):
@@ -83,7 +85,11 @@ class PeerRuntime:
                  resume: bool = False):
         import jax
 
-        from bcfl_tpu.dist.transport import PartitionGate, PeerTransport
+        from bcfl_tpu.dist.transport import (
+            PartitionGate,
+            PeerTransport,
+            WireChaos,
+        )
         from bcfl_tpu.fed.engine import FedEngine
 
         self.cfg = cfg
@@ -120,8 +126,10 @@ class PeerRuntime:
         self._last_hello = 0.0
         self.fork: Optional[Dict] = None
         self.reconcile: Optional[Dict] = None
-        self.send_failures = 0
+        self._below_quorum = False
+        self._below_quorum_events = 0  # episodes, not loop polls
         self._buffer: List[tuple] = []  # (header, trees, recv_time)
+        self._buffer_shed = 0  # oldest entries shed by the intake cap
         self._partitioned = False
         self._fork_comps = None
         self._pending_reconcile = False
@@ -137,10 +145,27 @@ class PeerRuntime:
         # very messages the partition blocks)
         self.gate = PartitionGate(plan, self.peers,
                                   version_fn=lambda: self.local_round)
+        # the wire chaos lane shares the gate's autonomous span clock (the
+        # peer's local round); an all-defaults plan injects nothing
+        chaos = (WireChaos(cfg.faults, clock_fn=lambda: self.local_round)
+                 if cfg.faults.wire_enabled else None)
         host = cfg.dist.host
+        # transport incarnation epoch: a file-backed restart counter, NOT
+        # wall clock — a backward clock step between a crash and its
+        # restart must not make receivers treat the new incarnation's
+        # messages as a dead one's stragglers
+        epoch_path = os.path.join(run_dir, f"epoch_peer{self.peer_id}")
+        try:
+            with open(epoch_path) as f:
+                epoch = int(f.read().strip()) + 1
+        except (OSError, ValueError):
+            epoch = 1
+        with open(epoch_path, "w") as f:
+            f.write(str(epoch))
         self.transport = PeerTransport(
             self.peer_id, [(host, p) for p in ports], gate=self.gate,
-            io_timeout_s=min(60.0, cfg.dist.peer_deadline_s))
+            io_timeout_s=min(60.0, cfg.dist.peer_deadline_s),
+            chaos=chaos, policy=cfg.dist, epoch=epoch)
 
         self.ckpt_dir = os.path.join(run_dir, f"ckpt_peer{self.peer_id}")
         if resume:
@@ -262,30 +287,71 @@ class PeerRuntime:
 
         leader = self._leader()
         if leader == self.peer_id:
-            self._buffer.append((dict(header, **{"from": self.peer_id}),
-                                 {"payload": wire_tree}, time.time()))
+            # the leader's own update gets a real (from, msg_id) identity
+            # too, so EVERY merged update is dedup-accountable
+            self._buffer_push((dict(header, **{
+                "from": self.peer_id,
+                "msg_id": self.transport.alloc_msg_id(self.peer_id),
+                "msg_epoch": self.transport.epoch}),
+                {"payload": wire_tree}, time.time()))
         else:
-            from bcfl_tpu.dist.transport import TransportError
-
-            try:
-                sent = self.transport.send(leader, header,
-                                           {"payload": wire_tree})
-                if not sent:
-                    logger.info("peer %d: partition gate blocked update to "
-                                "leader %d", self.peer_id, leader)
-            except TransportError as e:
-                self.send_failures += 1
-                logger.warning("peer %d: update send failed (%s)",
-                               self.peer_id, e)
+            # the transport's retrying seam owns failure handling (backoff,
+            # detector, counters); an undelivered update simply rebases on
+            # the next global broadcast
+            self.transport.send(leader, header, {"payload": wire_tree})
 
     # ------------------------------------------------------- leader: merging
 
+    def _buffer_push(self, entry: tuple):
+        """Leader-side FedBuff intake, BOUNDED: while merges are parked
+        (below quorum) the leader still trains and followers still send,
+        and each entry holds a model-sized wire tree — an uncapped list
+        would grow to OOM before the idle watchdog fires. Shed the OLDEST
+        (its stale lineage would be the first rejected at the eventual
+        merge anyway)."""
+        cap = max(4, 2 * self.peers, 2 * (self.cfg.dist.buffer or 1))
+        self._buffer.append(entry)
+        while len(self._buffer) > cap:
+            self._buffer.pop(0)
+            self._buffer_shed += 1
+
     def _maybe_merge(self):
+        import math
+
+        from bcfl_tpu.dist.transport import DOWN
+
         cfg = self.cfg
         comp = self._component()
-        want = min(cfg.dist.buffer or 1, len(comp))
+        # quorum degradation (RUNTIME.md "Delivery contract"): peers the
+        # failure detector holds DOWN don't count toward the buffer target
+        # — the leader proceeds on the reachable quorum instead of paying
+        # buffer_timeout_s per merge for updates that can never arrive.
+        # Below quorum_frac of the component it refuses to advance the
+        # global at all (the idle watchdog bounds that wait).
+        states = self.transport.detector.states()
+        down = [p for p in comp
+                if p != self.peer_id and states.get(p) == DOWN]
+        alive = [p for p in comp if p not in down]
+        if len(alive) < max(1, math.ceil(cfg.dist.quorum_frac * len(comp))):
+            # count EPISODES (entries into the below-quorum state), not
+            # main-loop polls — the surfaced number must not depend on
+            # how fast the host spins the loop
+            if not self._below_quorum:
+                self._below_quorum = True
+                self._below_quorum_events += 1
+            # with merges (and so broadcasts) parked, nothing else on the
+            # leader sends — so nothing would ever probe the DOWN peers
+            # and the below-quorum state would be ABSORBING even after
+            # the network heals. Ping them directly: send() rate-limits
+            # to one probe per probe_interval_s, a success flips the peer
+            # REACHABLE, and the next poll restores quorum.
+            for p in down:
+                self.transport.send(p, {"type": "ping"})
+            return
+        self._below_quorum = False
         if not self._buffer:
             return
+        want = min(cfg.dist.buffer or 1, len(alive))
         first_ts = self._buffer[0][2]
         if (len(self._buffer) < want
                 and time.time() - first_ts < cfg.dist.buffer_timeout_s):
@@ -305,7 +371,10 @@ class PeerRuntime:
         rec = MergeRecord(
             version=self.version, leader=self.peer_id, arrivals=arrivals,
             rejected=rejected, wall_s=time.time() - t0,
-            solo=self.gate.components() is not None)
+            solo=self.gate.components() is not None,
+            degraded=bool(down),
+            quorum=({"component": len(comp), "alive": len(alive),
+                     "down": down} if down else None))
         self.merges.append(rec)
         self._maybe_checkpoint()
         self._broadcast_global(healed=False)
@@ -317,7 +386,9 @@ class PeerRuntime:
         src = int(header["from"])
         base_v = int(header["base_version"])
         staleness = max(self.version - base_v, 0)
-        rec = {"peer": src, "round": int(header["round"]),
+        rec = {"peer": src, "msg_id": header.get("msg_id"),
+               "msg_epoch": header.get("msg_epoch"),
+               "round": int(header["round"]),
                "base_version": base_v, "staleness": staleness,
                "latency_s": max(recv_t - float(header["sent_at"]), 0.0)}
         # lineage check (BOTH wire formats) BEFORE anything touches the
@@ -408,8 +479,6 @@ class PeerRuntime:
     def _broadcast_global(self, healed: bool, full: bool = False):
         import jax
 
-        from bcfl_tpu.dist.transport import TransportError
-
         header = {
             "type": "global", "version": int(self.version),
             "healed": bool(healed),
@@ -431,12 +500,9 @@ class PeerRuntime:
         for p in self._component():
             if p == self.peer_id:
                 continue
-            try:
-                self.transport.send(p, header, {"model": model})
-            except TransportError as e:
-                self.send_failures += 1
-                logger.warning("peer %d: global broadcast to %d failed (%s)",
-                               self.peer_id, p, e)
+            # retrying seam; a peer that misses the broadcast resyncs via
+            # HELLO, and a dead one trips the detector toward DOWN
+            self.transport.send(p, header, {"model": model})
 
     # --------------------------------------------------- partition lifecycle
 
@@ -482,8 +548,6 @@ class PeerRuntime:
         an adopted global clears the pending flag."""
         import jax
 
-        from bcfl_tpu.dist.transport import TransportError
-
         if not self.gate.allowed(self.peer_id, 0):
             return
         if time.time() - self._last_reconcile_try < 2.0:
@@ -495,12 +559,9 @@ class PeerRuntime:
             "weight": self._solo_weight(),
         }
         model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
-        try:
-            self.transport.send(0, header, {"model": model})
-        except TransportError as e:
-            self.send_failures += 1
-            logger.warning("peer %d: reconcile send failed (%s); will retry",
-                           self.peer_id, e)
+        # retrying seam; undelivered offers re-fire on the throttle until a
+        # healed global supersedes them
+        self.transport.send(0, header, {"model": model})
 
     def _handle_reconcile(self, header: Dict, trees: Dict):
         """Global leader's side of the heal: verify the fork segment, adopt
@@ -574,11 +635,8 @@ class PeerRuntime:
         if time.time() - self._last_hello < 2.0:
             return
         self._last_hello = time.time()
-        try:
-            self.transport.send(leader, {"type": "hello",
-                                         "version": int(self.version)})
-        except Exception:
-            pass
+        self.transport.send(leader, {"type": "hello",
+                                     "version": int(self.version)})
 
     def _handle_global(self, header: Dict, trees: Dict):
         from bcfl_tpu.ledger import Ledger
@@ -642,8 +700,6 @@ class PeerRuntime:
             return
         import jax
 
-        from bcfl_tpu.dist.transport import TransportError
-
         src = int(header["from"])
         reply = {
             "type": "global", "version": int(self.version), "healed": False,
@@ -657,11 +713,9 @@ class PeerRuntime:
         else:
             reply["chain"] = None
         model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
-        try:
-            self.transport.send(src, reply, {"model": model})
-        except TransportError as e:
-            logger.warning("peer %d: hello reply to %d failed (%s)",
-                           self.peer_id, src, e)
+        # retrying seam; an undelivered reply re-fires on the rejoiner's
+        # next throttled HELLO
+        self.transport.send(src, reply, {"model": model})
 
     # --------------------------------------------------- checkpoint / resume
 
@@ -737,9 +791,11 @@ class PeerRuntime:
         kind = header.get("type")
         if kind == "update":
             if self._leader() == self.peer_id:
-                self._buffer.append((header, trees, time.time()))
+                self._buffer_push((header, trees, time.time()))
             # an update addressed to a stale leader is dropped: the sender
             # will rebase on the next global broadcast
+        elif kind == "ping":
+            pass  # liveness probe: delivery (the ack) was the answer
         elif kind == "global":
             self._handle_global(header, trees)
         elif kind == "reconcile":
@@ -754,10 +810,6 @@ class PeerRuntime:
                            self.peer_id, kind)
 
     def _finalize(self):
-        import jax
-
-        from bcfl_tpu.dist.transport import TransportError
-
         loss = acc = None
         try:
             loss, acc = self.eng._global_eval(self.trainable)
@@ -767,11 +819,9 @@ class PeerRuntime:
         for p in range(self.peers):
             if p == self.peer_id:
                 continue
-            try:
-                self.transport.send(p, {"type": "shutdown",
-                                        "version": int(self.version)})
-            except TransportError:
-                pass
+            # retrying seam; a DOWN peer's circuit skips this instantly
+            self.transport.send(p, {"type": "shutdown",
+                                    "version": int(self.version)})
         self._stop = True
 
     def run(self) -> int:
@@ -780,11 +830,8 @@ class PeerRuntime:
                     self.version, " (resumed)" if self._resumed else "")
         self.transport.start()
         if self._resumed and self.peer_id != 0:
-            try:
-                self.transport.send(0, {"type": "hello",
-                                        "version": int(self.version)})
-            except Exception:
-                pass
+            self.transport.send(0, {"type": "hello",
+                                    "version": int(self.version)})
         try:
             while not self._stop:
                 self._check_watchdogs()
@@ -833,6 +880,7 @@ class PeerRuntime:
     def _write_report(self, status: str):
         staleness = [a["staleness"] for m in self.merges for a in m.arrivals]
         latencies = [a["latency_s"] for m in self.merges for a in m.arrivals]
+        tstats = self.transport.stats()
         report = {
             "peer": self.peer_id,
             "peers": self.peers,
@@ -843,11 +891,15 @@ class PeerRuntime:
             "local_rounds": int(self.local_round),
             "merges": [dataclasses.asdict(m) for m in self.merges],
             "solo_merges": sum(1 for m in self.merges if m.solo),
+            "degraded_merges": sum(1 for m in self.merges if m.degraded),
+            "below_quorum_events": self._below_quorum_events,
+            "buffer_shed": self._buffer_shed,
             "adopted_versions": self.adopted,
             "staleness_values": staleness,
             "arrival_latency_s": latencies,
-            "send_failures": self.send_failures,
-            "dropped_by_gate": self.transport.dropped_by_gate,
+            "transport": tstats,
+            "send_failures": tstats["send_failures"],
+            "dropped_by_gate": tstats["dropped_by_gate"],
             "fork": self.fork,
             "reconcile": self.reconcile,
             "chain_len": len(self.chain) if self.chain is not None else None,

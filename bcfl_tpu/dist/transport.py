@@ -1,11 +1,37 @@
-"""Host-level peer transport: loopback/DCN TCP with an injectable partition
-gate (RUNTIME.md §3).
+"""Host-level peer transport: self-healing loopback/DCN TCP with an
+injectable partition gate and a seeded wire-chaos lane (RUNTIME.md §3 and
+"Delivery contract").
 
 One :class:`PeerTransport` per peer process: a listener thread accepts
-connections on the peer's own port and enqueues complete frames into an
-inbox; sends open a fresh connection per message (loopback connects are
-~microseconds, and connection-per-message means a crashed receiver can
-never wedge a cached socket). Every operation runs under a hard deadline.
+connections on the peer's own port and enqueues complete frames into a
+BOUNDED inbox; sends open a fresh connection per message (loopback
+connects are ~microseconds, and connection-per-message means a crashed
+receiver can never wedge a cached socket). Every operation runs under a
+hard deadline.
+
+The delivery contract (all of it lives here, so the runtime's handlers
+stay single-purpose):
+
+- **At-least-once**: every frame carries a monotone per-destination
+  ``(from, msg_id)`` (plus the sender's incarnation epoch, so a restarted
+  peer's fresh counter cannot collide with its dead incarnation's ids)
+  and a CRC32; delivery is confirmed by the receiver's 4-byte ack. A failed attempt (unreachable, timeout, CRC-dropped, chaos-
+  dropped) retries with exponential backoff + deterministic jitter under a
+  per-destination deadline budget — :meth:`PeerTransport.send` is the ONE
+  reliable send seam and never raises on network failure.
+- **Idempotent receive**: the receiver verifies the CRC before parsing a
+  single field (damage -> ``crc_drops``, no ack, the sender retries),
+  then dedups on a per-sender msg-id window (``dups_dropped``) — a
+  retried or chaos-duplicated frame can never be handled twice, which is
+  what makes the runtime's UPDATE merge / MODEL adopt / HELLO / reconcile
+  handlers provably idempotent under this transport.
+- **Failure detection**: every attempt outcome feeds a per-peer circuit
+  breaker (:class:`FailureDetector`): consecutive failures move a peer
+  REACHABLE -> SUSPECT -> DOWN; while DOWN the circuit is open and sends
+  are skipped except one probe per interval, so a dead peer costs ~zero
+  per message and a recovered one is re-detected within a probe interval.
+  The detector's states and transition log ride the peer report — the
+  evidence vocabulary quorum degradation and quarantine consume.
 
 The **partition gate** is the FaultPlan partition lane driven at the socket
 level: a callable consulted on BOTH ends of every message — the sender
@@ -14,7 +40,17 @@ blocked *by its own clock* (authoritative, so a component can never merge a
 cross-partition update even when the two peers disagree about exactly when
 the span started). While the gate blocks a pair, the two sides genuinely
 cannot exchange bytes — each connected component evolves (and extends its
-ledger chain) independently, which is what makes the fork real.
+ledger chain) independently, which is what makes the fork real. Gate drops
+happen AFTER the ack (the frame was delivered intact; the application
+discarded it), so a partition never masquerades as peer death to the
+failure detector — the two failure modes stay distinguishable.
+
+The **wire chaos lane** (:class:`WireChaos`, FaultPlan ``wire_*``) injects
+drop / duplicate / reorder-hold / delay-jitter / byte-corruption per
+transmission attempt, drawn from ``(seed, lane, round, src, dst, msg_id,
+attempt)`` — deterministic and replayable given the same message
+coordinates, which is what lets ``scripts/dist_chaos.py`` assert exact
+self-healing behavior under an adversarial schedule.
 """
 
 from __future__ import annotations
@@ -23,16 +59,106 @@ import logging
 import queue
 import socket
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from bcfl_tpu.dist.wire import WireError, read_frame, write_frame
+from bcfl_tpu.config import DistConfig
+from bcfl_tpu.dist.wire import (
+    PREFIX_LEN,
+    CrcError,
+    WireError,
+    pack_frame,
+    read_ack,
+    read_frame,
+    write_ack,
+)
 from bcfl_tpu.faults import FaultPlan
 
 logger = logging.getLogger(__name__)
 
 
 class TransportError(RuntimeError):
-    """Send failed: destination unreachable / refused / deadline passed."""
+    """One send ATTEMPT failed (unreachable / refused / deadline / chaos
+    drop / no ack). Internal to the retry seam — :meth:`PeerTransport.send`
+    absorbs it into the backoff loop and the stats counters."""
+
+
+# failure-detector states (RUNTIME.md "Delivery contract")
+REACHABLE = "reachable"
+SUSPECT = "suspect"
+DOWN = "down"
+
+
+class FailureDetector:
+    """Per-peer circuit-breaker failure detector.
+
+    Consecutive send-attempt failures move a peer REACHABLE -> SUSPECT
+    (``suspect_after``) -> DOWN (``down_after``); any success snaps it back
+    to REACHABLE. While DOWN the circuit is open: :meth:`allow` returns
+    False except for one probe per ``probe_interval_s``. Thread-safe (the
+    serving threads never write it today, but the lock keeps that a
+    non-invariant)."""
+
+    def __init__(self, peers: int, suspect_after: int = 2,
+                 down_after: int = 6, probe_interval_s: float = 2.0):
+        import collections
+
+        self.suspect_after = int(suspect_after)
+        self.down_after = int(down_after)
+        self.probe_interval_s = float(probe_interval_s)
+        self._state = {p: REACHABLE for p in range(int(peers))}
+        self._fails = {p: 0 for p in range(int(peers))}
+        self._last_probe = {p: 0.0 for p in range(int(peers))}
+        # bounded: a long-lived peer on a lossy link flaps at message
+        # rate, and the full log is serialized into every report — keep
+        # the recent window (enough for the chaos gates) plus a total
+        self.transitions = collections.deque(maxlen=256)
+        self.transitions_total = 0
+        self._lock = threading.Lock()
+
+    def _set(self, peer: int, state: str) -> None:
+        old = self._state[peer]
+        if old == state:
+            return
+        self._state[peer] = state
+        self.transitions_total += 1
+        self.transitions.append(
+            {"peer": int(peer), "from": old, "to": state,
+             "at": time.time()})
+
+    def state_of(self, peer: int) -> str:
+        with self._lock:
+            return self._state[peer]
+
+    def states(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._state)
+
+    def on_success(self, peer: int) -> None:
+        with self._lock:
+            self._fails[peer] = 0
+            self._set(peer, REACHABLE)
+
+    def on_failure(self, peer: int) -> None:
+        with self._lock:
+            self._fails[peer] += 1
+            if self._fails[peer] >= self.down_after:
+                self._set(peer, DOWN)
+            elif self._fails[peer] >= self.suspect_after:
+                self._set(peer, SUSPECT)
+
+    def allow(self, peer: int) -> bool:
+        """Should a send to ``peer`` be attempted now? True unless the
+        circuit is open (DOWN) and no probe is due; a granted probe
+        reserves the interval."""
+        with self._lock:
+            if self._state[peer] != DOWN:
+                return True
+            now = time.monotonic()
+            if now - self._last_probe[peer] >= self.probe_interval_s:
+                self._last_probe[peer] = now
+                return True
+            return False
 
 
 class PartitionGate:
@@ -77,27 +203,102 @@ class PartitionGate:
         return ca == cb
 
 
+class WireChaos:
+    """FaultPlan wire lane bound to one sender: draws per-(message,
+    attempt) socket faults with the peer's local round as the lane clock
+    (the same autonomous clock the partition gate uses — it advances with
+    the peer's own training loop, never via the faulted messages)."""
+
+    def __init__(self, plan: Optional[FaultPlan],
+                 clock_fn: Callable[[], int]):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.clock_fn = clock_fn
+
+    def actions(self, src: int, dst: int, msg_id: int,
+                attempt: int) -> Optional[dict]:
+        return self.plan.wire_actions(int(self.clock_fn()), src, dst,
+                                      msg_id, attempt)
+
+
+def _flip_payload_bytes(frame: bytes, fracs) -> bytes:
+    """In-flight byte damage: XOR-flip payload bytes at the fraction-chosen
+    positions (past the magic/length/crc prefix, so the receiver sees a
+    well-framed message whose CRC no longer matches — the realistic
+    corruption the checksum exists for)."""
+    buf = bytearray(frame)
+    n = len(buf) - PREFIX_LEN
+    if n <= 0:
+        return frame
+    for f in fracs:
+        buf[PREFIX_LEN + min(int(f * n), n - 1)] ^= 0xFF
+    return bytes(buf)
+
+
 class PeerTransport:
     """Frame transport bound to one peer id.
 
     ``addrs[p]`` is peer ``p``'s ``(host, port)``; the transport listens on
     its own address and connects outward per send. ``gate`` (optional) is
-    consulted on both send and receive."""
+    consulted on both send and receive; ``chaos`` (optional) is the wire
+    fault lane; ``policy`` (a :class:`DistConfig`) carries the retry /
+    detector / dedup / inbox knobs."""
 
     def __init__(self, peer_id: int, addrs: List[Tuple[str, int]],
                  gate: Optional[PartitionGate] = None,
                  connect_timeout_s: float = 5.0,
-                 io_timeout_s: float = 60.0):
+                 io_timeout_s: float = 60.0,
+                 chaos: Optional[WireChaos] = None,
+                 policy: Optional[DistConfig] = None,
+                 epoch: Optional[int] = None):
         self.peer_id = int(peer_id)
         self.addrs = list(addrs)
         self.gate = gate
+        self.chaos = chaos
+        self.policy = policy if policy is not None else DistConfig()
         self.connect_timeout_s = connect_timeout_s
         self.io_timeout_s = io_timeout_s
-        self.inbox: "queue.Queue" = queue.Queue()
-        self.dropped_by_gate = 0  # receiver-side partition drops (observability)
+        self.inbox: "queue.Queue" = queue.Queue(
+            maxsize=self.policy.inbox_max)
+        self.detector = FailureDetector(
+            len(addrs), self.policy.suspect_after, self.policy.down_after,
+            self.policy.probe_interval_s)
+        # --- observability counters (stats()) ---
+        self.retries = 0            # re-attempts after a failed attempt
+        self.send_failures = 0      # logical sends that exhausted the budget
+        self.dups_dropped = 0       # dedup-window drops (at-least-once tax)
+        self.crc_drops = 0          # inbound frames failing their CRC
+        self.wire_drops = 0         # inbound frames malformed/stalled
+        self.inbox_overflow = 0     # frames shed by the bounded inbox
+        self.reorders_held = 0      # frames held for chaos reordering
+        self.circuit_skips = 0      # sends skipped on an open circuit
+        self.dropped_by_gate = 0    # receiver-side partition drops
+        self.chaos_injected = {"drop": 0, "dup": 0, "reorder": 0,
+                               "delay": 0, "corrupt": 0}
+        # the sender's incarnation epoch: part of the dedup identity, so a
+        # restarted peer (fresh msg-id counter) opens a fresh window at
+        # every receiver instead of colliding with its dead incarnation's
+        # ids — crash/rejoin cannot have its first HELLOs eaten as "dups".
+        # Callers that can persist state across restarts (PeerRuntime's
+        # file-backed restart counter) pass ``epoch`` explicitly —
+        # guaranteed monotone even when the wall clock steps backward
+        # between incarnations; the wall-ms default covers ad-hoc use.
+        self.epoch = (int(epoch) if epoch is not None
+                      else time.time_ns() // 1_000_000)
+        self._next_msg_id: Dict[int, int] = {}
+        self._dedup_seen: Dict[int, set] = {}
+        self._dedup_max: Dict[int, int] = {}
+        self._dedup_epoch: Dict[int, int] = {}
+        self._dedup_lock = threading.Lock()
+        # receive-path counters are bumped from concurrent per-connection
+        # serve threads: a plain += is a racy read-add-store there
+        self._stats_lock = threading.Lock()
         self._server: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._closing = threading.Event()
+
+    def _bump(self, name: str) -> None:
+        with self._stats_lock:
+            setattr(self, name, getattr(self, name) + 1)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -125,6 +326,7 @@ class PeerTransport:
     def _accept_loop(self) -> None:
         while not self._closing.is_set():
             try:
+                # deadline: settimeout(0.25) on the listener in start()
                 conn, _ = self._server.accept()
             except socket.timeout:
                 continue
@@ -134,45 +336,151 @@ class PeerTransport:
                                  daemon=True)
             t.start()
 
+    # --------------------------------------------------------------- receive
+
     def _serve_one(self, conn: socket.socket) -> None:
+        """Receive pipeline for one connection. The ack is the LAST step:
+        it confirms the frame was delivered AND accepted (enqueued, or
+        deliberately discarded by gate/dedup/hostile-header policy — an
+        application decision that must not feed the sender's failure
+        detector). The one case that withholds the ack besides wire
+        damage is inbox overflow: an acked-then-shed frame would be
+        unrecoverable (the sender stops retrying and the dedup window
+        would eat any retransmit), so overflow refuses the ack and
+        un-records the id — at-least-once survives a full inbox."""
         try:
             with conn:
                 header, trees = read_frame(conn, self.io_timeout_s)
+                try:
+                    # CRC is integrity, not authentication: a well-CRC'd
+                    # frame can still carry hostile field TYPES
+                    # ("from": "abc"). Coerce them here so a garbage
+                    # header is a counted drop, never a dead serving
+                    # thread.
+                    src = int(header.get("from", -1))
+                    msg_id = header.get("msg_id")
+                    if msg_id is not None:
+                        msg_id = int(msg_id)
+                    epoch = int(header.get("msg_epoch") or 0)
+                    hold = float(header.pop("chaos_hold_s", 0.0) or 0.0)
+                except (TypeError, ValueError) as e:
+                    self._bump("wire_drops")
+                    logger.warning("peer %d: dropped frame with hostile "
+                                   "header fields: %s", self.peer_id, e)
+                    self._ack(conn)  # delivered garbage: never retryable
+                    return
+                if (self.gate is not None
+                        and not self.gate.allowed(self.peer_id, src)):
+                    # the RECEIVER'S clock is authoritative: a frame from
+                    # across the partition is dropped before anything can
+                    # merge it
+                    self._bump("dropped_by_gate")
+                    logger.info("peer %d: partition gate dropped %s from "
+                                "peer %d", self.peer_id,
+                                header.get("type"), src)
+                    self._ack(conn)
+                    return
+                if msg_id is not None and not self._dedup_accept(
+                        src, epoch, msg_id):
+                    self._bump("dups_dropped")
+                    logger.info("peer %d: dedup dropped duplicate %s "
+                                "(%d, %d)", self.peer_id,
+                                header.get("type"), src, msg_id)
+                    self._ack(conn)
+                    return
+                if hold > 0:
+                    # chaos reorder: hold this frame so later arrivals
+                    # overtake it in the inbox — the ordering scramble the
+                    # idempotent handlers must tolerate. Capacity is
+                    # checked NOW (the ack decision is due while the
+                    # sender waits); a flood arriving during the hold can
+                    # still shed the release — an accepted chaos-only
+                    # residual.
+                    if self.inbox.full():
+                        self._shed_overflow(header, src, msg_id)
+                        return
+                    self._bump("reorders_held")
+                    t = threading.Timer(hold, self._enqueue,
+                                        args=(header, trees))
+                    t.daemon = True
+                    t.start()
+                    self._ack(conn)
+                elif self._enqueue(header, trees):
+                    self._ack(conn)
+                else:
+                    self._shed_overflow(header, src, msg_id,
+                                        counted=True)
+        except CrcError as e:
+            self._bump("crc_drops")
+            logger.warning("peer %d: dropped corrupt inbound frame: %s",
+                           self.peer_id, e)
         except (WireError, OSError, socket.timeout) as e:
+            self._bump("wire_drops")
             logger.warning("peer %d: dropped malformed/stalled inbound "
                            "frame: %s", self.peer_id, e)
-            return
-        src = int(header.get("from", -1))
-        if self.gate is not None and not self.gate.allowed(self.peer_id, src):
-            # the RECEIVER'S clock is authoritative: a frame from across the
-            # partition is dropped before anything can merge it
-            self.dropped_by_gate += 1
-            logger.info("peer %d: partition gate dropped %s from peer %d",
-                        self.peer_id, header.get("type"), src)
-            return
-        self.inbox.put((header, trees))
 
-    # ------------------------------------------------------------------ send
-
-    def send(self, to: int, header: Dict, trees: Optional[Dict] = None,
-             timeout_s: Optional[float] = None) -> bool:
-        """Send one frame to peer ``to``. Returns False when the partition
-        gate blocks the pair (not an error: the caller is supposed to act
-        partitioned); raises :class:`TransportError` when the destination
-        is genuinely unreachable within the deadline."""
-        if self.gate is not None and not self.gate.allowed(self.peer_id, to):
-            return False
-        header = dict(header, **{"from": self.peer_id})
-        host, port = self.addrs[to]
+    def _ack(self, conn: socket.socket) -> None:
         try:
-            with socket.create_connection(
-                    (host, port), timeout=self.connect_timeout_s) as sock:
-                write_frame(sock, header, trees,
-                            timeout_s=timeout_s or self.io_timeout_s)
-        except (OSError, socket.timeout) as e:
-            raise TransportError(
-                f"peer {self.peer_id} -> {to} ({host}:{port}): {e}") from e
-        return True
+            write_ack(conn)
+        except OSError:
+            # the sender vanished mid-handshake; it will retry and the
+            # dedup window absorbs the duplicate
+            pass
+
+    def _shed_overflow(self, header: Dict, src: int,
+                       msg_id: Optional[int],
+                       counted: bool = False) -> None:
+        """Bounded-inbox shed: count it, un-record the dedup id, and do
+        NOT ack — the sender's retry (or a later retransmit) can still
+        deliver once the inbox drains."""
+        if not counted:
+            self._bump("inbox_overflow")
+        if msg_id is not None:
+            self._dedup_unrecord(src, msg_id)
+        logger.warning("peer %d: inbox full (%d); refused %s (sender "
+                       "will retry)", self.peer_id, self.policy.inbox_max,
+                       header.get("type"))
+
+    def _enqueue(self, header: Dict, trees: Dict) -> bool:
+        try:
+            self.inbox.put_nowait((header, trees))
+            return True
+        except queue.Full:
+            self._bump("inbox_overflow")
+            return False
+
+    def _dedup_accept(self, src: int, epoch: int, msg_id: int) -> bool:
+        """Record-and-test one (sender, epoch, msg_id): False for a
+        duplicate or an id older than the window (treated as a duplicate —
+        dropping a too-old retransmit is always safe under at-least-once).
+        A NEWER sender epoch (process restart) resets the window; an older
+        one is a dead incarnation's delayed frame and is never handled."""
+        with self._dedup_lock:
+            cur = self._dedup_epoch.get(src)
+            if cur is None or epoch > cur:
+                self._dedup_epoch[src] = epoch
+                self._dedup_seen[src] = set()
+                self._dedup_max[src] = -1
+            elif epoch < cur:
+                return False
+            seen = self._dedup_seen.setdefault(src, set())
+            newest = self._dedup_max.get(src, -1)
+            if msg_id <= newest - self.policy.dedup_window or msg_id in seen:
+                return False
+            seen.add(msg_id)
+            if msg_id > newest:
+                self._dedup_max[src] = msg_id
+            if len(seen) > 2 * self.policy.dedup_window:
+                cut = self._dedup_max[src] - self.policy.dedup_window
+                self._dedup_seen[src] = {i for i in seen if i > cut}
+            return True
+
+    def _dedup_unrecord(self, src: int, msg_id: int) -> None:
+        """Forget a recorded id whose frame was shed before handling
+        (inbox overflow): the sender's retransmit must not be rejected as
+        a duplicate of a delivery that never happened."""
+        with self._dedup_lock:
+            self._dedup_seen.get(src, set()).discard(msg_id)
 
     def recv(self, timeout_s: float) -> Optional[Tuple[Dict, Dict]]:
         """Next inbound (header, trees), or None after ``timeout_s``."""
@@ -180,3 +488,189 @@ class PeerTransport:
             return self.inbox.get(timeout=timeout_s)
         except queue.Empty:
             return None
+
+    # ------------------------------------------------------------------ send
+
+    def alloc_msg_id(self, to: int) -> int:
+        """Next monotone message id for destination ``to`` (the leader also
+        draws ids for its own self-buffered updates, so every merged update
+        has a unique (from, msg_id) identity)."""
+        i = self._next_msg_id.get(to, 0)
+        self._next_msg_id[to] = i + 1
+        return i
+
+    def send(self, to: int, header: Dict, trees: Optional[Dict] = None,
+             timeout_s: Optional[float] = None) -> bool:
+        """THE one reliable send seam (at-least-once). Stamps the frame
+        with this peer's id and a monotone ``msg_id``, then retries failed
+        attempts with exponential backoff + deterministic jitter under the
+        per-destination deadline budget (``timeout_s`` or
+        ``policy.send_deadline_s``), feeding every attempt outcome to the
+        failure detector.
+
+        Returns True once the destination acked one copy; False when the
+        partition gate blocks the pair, the circuit is open (peer DOWN, no
+        probe due), or the retry budget expired. It never raises on
+        network failure — call sites need no per-call error handling; the
+        :meth:`stats` counters and the detector carry the evidence."""
+        if self.gate is not None and not self.gate.allowed(self.peer_id, to):
+            return False
+        if not self.detector.allow(to):
+            self.circuit_skips += 1
+            return False
+        # a granted probe of a DOWN peer is a SINGLE attempt under a
+        # probe-interval-bounded budget: a BLACK-HOLING corpse (SYNs
+        # dropped, not refused — real DCN) must cost at most one probe
+        # budget per interval, never connect_timeout_s inline in the peer
+        # loop per message, and a full retry loop per probe would turn
+        # "a corpse costs ~zero" into the leader spending its wall time
+        # probing
+        state = self.detector.state_of(to)
+        probe = state == DOWN
+        msg_id = self.alloc_msg_id(to)
+        header = dict(header, **{"from": self.peer_id, "msg_id": msg_id,
+                                 "msg_epoch": self.epoch})
+        pol = self.policy
+        budget_s = timeout_s if timeout_s is not None else pol.send_deadline_s
+        if probe:
+            # bound the probe: a single cheap ping under a probe-interval
+            # budget, never the full send deadline inline in the peer
+            # loop. ONLY true probes (state DOWN) are capped — capping
+            # SUSPECT sends too would starve any frame whose genuine
+            # wire time exceeds the probe budget (a model-sized update
+            # on a slow link would flap SUSPECT->DOWN->REACHABLE forever
+            # while only tiny pings get through). The cost: a
+            # black-holing destination can freeze the loop for up to
+            # send_deadline_s per send during the bounded SUSPECT
+            # transient (at most ~down_after failed attempts) before the
+            # circuit opens — tune send_deadline_s/down_after for the
+            # link, the transient is bounded, starvation would not be
+            budget_s = min(budget_s, pol.probe_interval_s)
+        deadline = time.monotonic() + budget_s
+        # serialize ONCE per logical send: a retry of an unchanged frame
+        # (the common case — only chaos reorder mutates the header) must
+        # not re-pack a potentially multi-hundred-MB model tree per attempt
+        frame = pack_frame(header, trees)
+        attempt = 0
+        while True:
+            acts = (self.chaos.actions(self.peer_id, to, msg_id, attempt)
+                    if self.chaos is not None else None)
+            try:
+                self._attempt(to, header, trees, frame, acts, deadline)
+                self.detector.on_success(to)
+                return True
+            except TransportError as e:
+                self.detector.on_failure(to)
+                attempt += 1
+                backoff = min(pol.retry_base_s * (2 ** (attempt - 1)),
+                              pol.retry_max_s)
+                # deterministic jitter in [0.5, 1.5): desynchronizes
+                # lockstep retries without a nondeterministic RNG. The
+                # sender/destination ids are in the hash — every peer's
+                # per-destination msg ids start at 0, so an id-only hash
+                # would have all followers of a briefly-dead leader retry
+                # in unison (the herd jitter exists to break up)
+                backoff *= 0.5 + ((self.peer_id * 7919 + to * 104729
+                                   + msg_id * 2654435761 + attempt * 97)
+                                  % 1024) / 1024.0
+                if (probe or attempt > pol.send_retries
+                        or time.monotonic() + backoff >= deadline):
+                    self.send_failures += 1
+                    # a failed probe of an already-DOWN peer is the
+                    # expected steady state, not news — keep the warning
+                    # for real delivery failures
+                    logger.log(
+                        logging.DEBUG if probe else logging.WARNING,
+                        "peer %d -> %d: %s msg %d undelivered after %d "
+                        "attempt(s): %s", self.peer_id, to,
+                        header.get("type"), msg_id, attempt, e)
+                    return False
+                self.retries += 1
+                logger.debug("peer %d -> %d: attempt %d failed (%s); "
+                             "retrying in %.2fs", self.peer_id, to,
+                             attempt, e, backoff)
+                time.sleep(backoff)
+
+    def _attempt(self, to: int, header: Dict, trees: Optional[Dict],
+                 frame: bytes, acts: Optional[dict],
+                 deadline: float) -> None:
+        """One transmission attempt: chaos injection, connect, frame, ack.
+        ``frame`` is the pre-packed clean frame; only the chaos reorder
+        path (header mutation) re-packs. Raises :class:`TransportError`
+        on any failure."""
+        if acts is not None and acts["delay_s"] > 0:
+            self.chaos_injected["delay"] += 1
+            time.sleep(min(acts["delay_s"],
+                           max(deadline - time.monotonic(), 0.0)))
+        if acts is not None and acts["reorder_s"] > 0:
+            self.chaos_injected["reorder"] += 1
+            frame = pack_frame(dict(header, chaos_hold_s=acts["reorder_s"]),
+                               trees)
+        on_wire = frame
+        if acts is not None and acts["corrupt"]:
+            self.chaos_injected["corrupt"] += 1
+            on_wire = _flip_payload_bytes(frame, acts["corrupt_pos"])
+        if acts is not None and acts["drop"]:
+            # the frame vanishes in the network: the receiver never sees
+            # it and the sender learns only via the missing ack — modeled
+            # without burning a real timeout so chaos runs stay fast
+            self.chaos_injected["drop"] += 1
+            raise TransportError(
+                f"chaos wire lane dropped msg {header['msg_id']} "
+                f"-> peer {to}")
+        self._deliver(to, on_wire, deadline)
+        if acts is not None and acts["dup"]:
+            # a duplicated delivery: second copy of the same on-wire
+            # bytes, best-effort, bounded by the SAME deadline budget as
+            # the main attempt — a stalling receiver must not hold the
+            # peer loop past the send's wall budget. The receiver's dedup
+            # window is what must absorb the copy.
+            self.chaos_injected["dup"] += 1
+            try:
+                self._deliver(to, frame, deadline)
+            except TransportError:
+                pass
+
+    def _deliver(self, to: int, on_wire: bytes, deadline: float) -> None:
+        """One physical delivery: connect, write the frame bytes, read
+        the ack — the single handshake both the real attempt and the
+        chaos duplicate go through, every socket op capped by the
+        remaining deadline budget. Raises :class:`TransportError`."""
+        budget = deadline - time.monotonic()
+        if budget <= 0:
+            raise TransportError(f"send deadline budget exhausted "
+                                 f"before attempt to peer {to}")
+        host, port = self.addrs[to]
+        try:
+            with socket.create_connection(
+                    (host, port),
+                    timeout=min(self.connect_timeout_s, budget)) as sock:
+                sock.settimeout(min(self.io_timeout_s, budget))
+                sock.sendall(on_wire)
+                read_ack(sock, timeout_s=min(self.io_timeout_s, budget))
+        except (OSError, socket.timeout, WireError) as e:
+            raise TransportError(
+                f"peer {self.peer_id} -> {to} ({host}:{port}): {e}") from e
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> Dict:
+        """Transport observability rollup for the peer report (and
+        ``results/dist_async.json`` / ``results/dist_chaos.json``)."""
+        return {
+            "retries": self.retries,
+            "send_failures": self.send_failures,
+            "dups_dropped": self.dups_dropped,
+            "crc_drops": self.crc_drops,
+            "wire_drops": self.wire_drops,
+            "inbox_overflow": self.inbox_overflow,
+            "reorders_held": self.reorders_held,
+            "circuit_skips": self.circuit_skips,
+            "dropped_by_gate": self.dropped_by_gate,
+            "chaos_injected": dict(self.chaos_injected),
+            "detector": {
+                "states": {str(p): s
+                           for p, s in self.detector.states().items()},
+                "transitions": list(self.detector.transitions),
+            },
+        }

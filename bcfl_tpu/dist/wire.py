@@ -10,13 +10,24 @@ sender produces a timeout, never a wedged peer.
 Frame layout (all integers little-endian):
 
     MAGIC "BCF1"
-    u64   frame_len                  # bytes after this field
+    u64   frame_len                  # bytes after the crc field
+    u32   crc32                      # zlib.crc32 over the whole payload
     u32   header_len, header JSON
     u32   ntrees
     per tree:
         u32  name_len, name (utf-8)
         u32  index_len, index JSON   # [{path, dtype, shape}] in body order
         u64  body_len, body          # concatenated raw C-order leaf bytes
+
+The CRC covers every payload byte (header JSON included), so any in-flight
+byte damage is rejected as :class:`CrcError` before a single field is
+parsed — a corrupted frame can never half-deliver a tree or feed garbage
+JSON to the handler. The receiver confirms an intact frame with a 4-byte
+:data:`ACK`; the sender treats a missing ack as a failed attempt and
+retries (at-least-once delivery — the transport's dedup window absorbs the
+resulting duplicates). A malformed payload (hostile index JSON, truncated
+tree, garbage dtype) always raises a clean :class:`WireError` — never a
+hang, never a partially-built tree.
 
 Trees are nested ``dict``s of arrays (flax param trees and codec payload
 dicts both are); leaf paths join nesting keys with the ``\\x1f`` unit
@@ -33,11 +44,15 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 MAGIC = b"BCF1"
+ACK = b"BCFA"  # receiver's delivery confirmation for one intact frame
+# bytes before the payload: magic (4) + u64 length (8) + u32 crc (4)
+PREFIX_LEN = 16
 # sanity cap: a corrupt/hostile length prefix must not OOM the peer. Full
 # BERT-base f32 is ~0.44 GB; 4 GiB leaves headroom for any model this repo
 # trains while still rejecting garbage lengths.
@@ -46,6 +61,10 @@ MAX_FRAME = 4 << 30
 
 class WireError(RuntimeError):
     """Malformed frame (bad magic, oversized length, truncated stream)."""
+
+
+class CrcError(WireError):
+    """Frame payload failed its CRC — bytes changed in flight."""
 
 
 SEP = "\x1f"  # key joiner; never appears in flax keys or codec path names
@@ -74,26 +93,54 @@ def pack_tree(tree: Any) -> Tuple[bytes, bytes]:
     return json.dumps(index).encode(), body
 
 
+def _json_loads(raw: bytes, what: str) -> Any:
+    """Decode hostile JSON into a value or a clean WireError — garbage
+    bytes on the wire must never surface as a JSONDecodeError deep in a
+    serving thread."""
+    try:
+        return json.loads(bytes(raw).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"malformed {what} JSON: {e}") from None
+
+
 def unpack_tree(index_json: bytes, body: bytes) -> Dict:
-    """(index JSON, body) -> nested dict of numpy arrays."""
+    """(index JSON, body) -> nested dict of numpy arrays. Any malformed
+    index — non-list JSON, garbage dtype, negative/overflowing shape, a
+    leaf extending past the body — raises :class:`WireError`; a partial
+    tree is never returned."""
     out: Dict = {}
     off = 0
-    for row in json.loads(index_json.decode()):
-        dt = np.dtype(row["dtype"])
-        shape = tuple(row["shape"])
-        n = dt.itemsize * int(np.prod(shape, dtype=np.int64)) if shape else dt.itemsize
-        if off + n > len(body):
-            raise WireError(
-                f"tree body truncated at leaf {row['path']!r} "
-                f"(need {off + n}, have {len(body)})")
-        arr = np.frombuffer(body, dt, count=n // dt.itemsize,
-                            offset=off).reshape(shape).copy()
-        off += n
-        node = out
-        parts = row["path"].split(SEP)
-        for k in parts[:-1]:
-            node = node.setdefault(k, {})
-        node[parts[-1]] = arr
+    rows = _json_loads(index_json, "tree index")
+    try:
+        for row in rows:
+            dt = np.dtype(row["dtype"])
+            shape = tuple(int(s) for s in row["shape"])
+            if any(s < 0 for s in shape):
+                raise WireError(f"negative dim in leaf shape {shape}")
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if count < 0 or count * dt.itemsize > MAX_FRAME:
+                raise WireError(f"leaf shape {shape} overflows MAX_FRAME")
+            n = dt.itemsize * count
+            if off + n > len(body):
+                raise WireError(
+                    f"tree body truncated at leaf {row['path']!r} "
+                    f"(need {off + n}, have {len(body)})")
+            arr = np.frombuffer(body, dt, count=count,
+                                offset=off).reshape(shape).copy()
+            off += n
+            node = out
+            parts = str(row["path"]).split(SEP)
+            for k in parts[:-1]:
+                node = node.setdefault(k, {})
+                if not isinstance(node, dict):
+                    raise WireError(f"leaf path {row['path']!r} descends "
+                                    "through a non-dict node")
+            node[parts[-1]] = arr
+    except WireError:
+        raise
+    except (TypeError, ValueError, KeyError, AttributeError) as e:
+        # hostile index rows (wrong types, unknown dtypes, missing keys)
+        raise WireError(f"malformed tree index: {e}") from None
     if off != len(body):
         raise WireError(f"tree body has {len(body) - off} trailing bytes")
     return out
@@ -114,11 +161,12 @@ def pack_frame(header: Dict, trees: Optional[Dict[str, Any]] = None) -> bytes:
     payload = b"".join(parts)
     if len(payload) > MAX_FRAME:
         raise WireError(f"frame of {len(payload)} bytes exceeds MAX_FRAME")
-    return MAGIC + struct.pack("<Q", len(payload)) + payload
+    return (MAGIC + struct.pack("<Q", len(payload))
+            + struct.pack("<I", zlib.crc32(payload)) + payload)
 
 
 def unpack_frame(payload: bytes) -> Tuple[Dict, Dict[str, Any]]:
-    """Bytes AFTER the magic+length prefix -> (header, {name: tree})."""
+    """Bytes AFTER the magic+length+crc prefix -> (header, {name: tree})."""
     view = memoryview(payload)
     off = 0
 
@@ -131,12 +179,18 @@ def unpack_frame(payload: bytes) -> Tuple[Dict, Dict[str, Any]]:
         return out
 
     (hdr_len,) = struct.unpack("<I", take(4))
-    header = json.loads(bytes(take(hdr_len)).decode())
+    header = _json_loads(take(hdr_len), "frame header")
+    if not isinstance(header, dict):
+        raise WireError(f"frame header is {type(header).__name__}, "
+                        "expected an object")
     (ntrees,) = struct.unpack("<I", take(4))
     trees = {}
     for _ in range(ntrees):
         (name_len,) = struct.unpack("<I", take(4))
-        name = bytes(take(name_len)).decode()
+        try:
+            name = bytes(take(name_len)).decode()
+        except UnicodeDecodeError as e:
+            raise WireError(f"malformed tree name: {e}") from None
         (idx_len,) = struct.unpack("<I", take(4))
         index = bytes(take(idx_len))
         (body_len,) = struct.unpack("<Q", take(8))
@@ -174,8 +228,8 @@ def _read_exact(sock: socket.socket, n: int,
 def read_frame(sock: socket.socket,
                timeout_s: Optional[float] = None) -> Tuple[Dict, Dict]:
     """Read one frame under a hard WHOLE-FRAME deadline. Raises
-    ``socket.timeout`` on deadline, :class:`WireError` on a malformed
-    stream."""
+    ``socket.timeout`` on deadline, :class:`CrcError` on in-flight byte
+    damage, :class:`WireError` on any other malformed stream."""
     import time
 
     deadline = (time.monotonic() + timeout_s
@@ -186,12 +240,26 @@ def read_frame(sock: socket.socket,
     (length,) = struct.unpack("<Q", _read_exact(sock, 8, deadline))
     if length > MAX_FRAME:
         raise WireError(f"frame length {length} exceeds MAX_FRAME")
-    return unpack_frame(_read_exact(sock, int(length), deadline))
+    (crc,) = struct.unpack("<I", _read_exact(sock, 4, deadline))
+    payload = _read_exact(sock, int(length), deadline)
+    if zlib.crc32(payload) != crc:
+        raise CrcError(f"payload CRC mismatch over {length} bytes")
+    return unpack_frame(payload)
 
 
-def write_frame(sock: socket.socket, header: Dict,
-                trees: Optional[Dict[str, Any]] = None,
-                timeout_s: Optional[float] = None) -> None:
-    if timeout_s is not None:
-        sock.settimeout(timeout_s)
-    sock.sendall(pack_frame(header, trees))
+def write_ack(sock: socket.socket) -> None:
+    """Confirm one intact frame back to the sender (4 bytes)."""
+    sock.sendall(ACK)
+
+
+def read_ack(sock: socket.socket, timeout_s: Optional[float] = None) -> None:
+    """Wait for the receiver's :data:`ACK` under a hard deadline. Raises
+    ``socket.timeout`` / :class:`WireError` when it never arrives — the
+    sender's retry path treats either as a failed attempt."""
+    import time
+
+    deadline = (time.monotonic() + timeout_s
+                if timeout_s is not None else None)
+    got = _read_exact(sock, len(ACK), deadline)
+    if got != ACK:
+        raise WireError(f"bad ack {got!r}")

@@ -45,6 +45,14 @@ def main(argv=None):
     ap.add_argument("--dist-deadline", type=float, default=600.0,
                     help="hard per-peer wall deadline in seconds for "
                          "--runtime dist (a hung peer fails the run)")
+    ap.add_argument("--dist-quorum", type=float, default=None,
+                    metavar="FRAC",
+                    help="quorum fraction for --runtime dist leaders: the "
+                         "merge target counts only peers the failure "
+                         "detector does NOT hold DOWN, and below this "
+                         "reachable fraction of the component the leader "
+                         "stops advancing the global (default 0.5; "
+                         "RUNTIME.md 'Delivery contract')")
     ap.add_argument("--task", choices=["classification", "causal_lm"],
                     default=None,
                     help="causal_lm = federated next-token fine-tuning "
@@ -227,6 +235,19 @@ def main(argv=None):
     ap.add_argument("--chaos-flaky-on-prob", type=float, default=None,
                     metavar="P", help="probability each flaky window "
                     "actually bursts (default 0.5)")
+    ap.add_argument("--chaos-wire", default=None, metavar="SPEC",
+                    help="wire-fault lane for --runtime dist (RUNTIME.md "
+                         "'Delivery contract'): comma list of K=V with K in "
+                         "{drop,dup,reorder,delay,corrupt} (per-message "
+                         "probabilities) plus optional delay-s / hold-s "
+                         "(seconds), e.g. "
+                         "'drop=0.2,dup=0.2,reorder=0.2,corrupt=0.05' — "
+                         "seeded socket-level frame drop / duplication / "
+                         "reorder-hold / delay-jitter / byte-corruption, "
+                         "absorbed by the self-healing transport")
+    ap.add_argument("--chaos-wire-rounds", default=None, metavar="START:END",
+                    help="bound the wire lane to this half-open span of the "
+                         "sender's local-round clock (default: every round)")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="seed of the chaos schedule (independent of --seed)")
     # peer-lifecycle reputation (bcfl_tpu.reputation, ROBUSTNESS.md §6)
@@ -360,7 +381,7 @@ def main(argv=None):
         or args.chaos_crash_round is not None
         or args.chaos_partition is not None
         or args.chaos_churn_leave or args.chaos_churn_join
-        or args.chaos_flaky is not None)
+        or args.chaos_flaky is not None or args.chaos_wire is not None)
     if chaos_flags:
         from bcfl_tpu.faults import FaultPlan
 
@@ -421,6 +442,47 @@ def main(argv=None):
                 plan_kw["flaky_burst_len"] = args.chaos_flaky_burst
             if args.chaos_flaky_on_prob is not None:
                 plan_kw["flaky_on_prob"] = args.chaos_flaky_on_prob
+        if args.chaos_wire is not None:
+            wire_keys = {"drop": "wire_drop_prob", "dup": "wire_dup_prob",
+                         "reorder": "wire_reorder_prob",
+                         "delay": "wire_delay_prob",
+                         "corrupt": "wire_corrupt_prob",
+                         "delay-s": "wire_delay_s",
+                         "hold-s": "wire_reorder_hold_s"}
+            for part in args.chaos_wire.split(","):
+                try:
+                    k, v = part.split("=")
+                    plan_kw[wire_keys[k.strip()]] = float(v)
+                except (ValueError, KeyError):
+                    raise SystemExit(
+                        f"--chaos-wire {part!r}: expected K=V with K in "
+                        f"{sorted(wire_keys)}")
+            if not any(plan_kw.get(wire_keys[k])
+                       for k in ("drop", "dup", "reorder", "delay",
+                                 "corrupt")):
+                # delay-s/hold-s alone arm nothing: the lane fires off
+                # probabilities — fail loudly instead of silently
+                # injecting zero faults under a chaos-looking flag
+                raise SystemExit(
+                    f"--chaos-wire {args.chaos_wire!r} sets no "
+                    "probability: add at least one of "
+                    "drop/dup/reorder/delay/corrupt > 0")
+        if args.chaos_wire_rounds is not None:
+            if args.chaos_wire is None:
+                raise SystemExit("--chaos-wire-rounds has no effect "
+                                 "without --chaos-wire")
+            try:
+                lo, hi = (int(x) for x in args.chaos_wire_rounds.split(":"))
+            except ValueError:
+                raise SystemExit(f"--chaos-wire-rounds "
+                                 f"{args.chaos_wire_rounds!r}: expected "
+                                 "START:END")
+            if hi <= lo:
+                raise SystemExit(f"--chaos-wire-rounds "
+                                 f"{args.chaos_wire_rounds!r}: empty span "
+                                 "(END must be > START; the span is "
+                                 "half-open)")
+            plan_kw["wire_rounds"] = tuple(range(lo, hi))
         overrides["faults"] = FaultPlan(**plan_kw)
     rep_tweaks = {
         "ewma_alpha": args.reputation_alpha,
@@ -441,6 +503,8 @@ def main(argv=None):
             cfg.reputation, enabled=True, **rep_tweaks)
     if args.peers is not None and args.runtime != "dist":
         raise SystemExit("--peers only applies to --runtime dist")
+    if args.dist_quorum is not None and args.runtime != "dist":
+        raise SystemExit("--dist-quorum only applies to --runtime dist")
     if args.runtime is not None:
         # runtime joins the ONE combined replace below: applying sync/mode/
         # faults first with runtime still "local" would run the local-
@@ -453,9 +517,11 @@ def main(argv=None):
             overrides.setdefault("sync", "async")
             overrides.setdefault("mode", "server")
             overrides.setdefault("eval_every", 0)
-            overrides["dist"] = dataclasses.replace(
-                cfg.dist, peers=args.peers or cfg.dist.peers,
-                peer_deadline_s=args.dist_deadline)
+            dist_kw = dict(peers=args.peers or cfg.dist.peers,
+                           peer_deadline_s=args.dist_deadline)
+            if args.dist_quorum is not None:
+                dist_kw["quorum_frac"] = args.dist_quorum
+            overrides["dist"] = dataclasses.replace(cfg.dist, **dist_kw)
     cfg = cfg.replace(**overrides)
 
     fused_tamper = None

@@ -45,7 +45,17 @@ into one seeded, deterministic, config-level schedule:
   per window, each window bad with ``flaky_on_prob``). This is the input
   that makes reputation-driven quarantine (bcfl_tpu.reputation)
   non-vacuous: the per-round Bernoulli ``corrupt_*`` lane has no repeat
-  offenders to remember.
+  offenders to remember,
+- **wire** — socket-level message faults for the dist runtime
+  (``runtime="dist"`` only; RUNTIME.md "Delivery contract"): per-message
+  drop / duplicate / reorder-hold / delay-jitter / byte-corruption, drawn
+  per transmission attempt from ``(seed, lane, round, src, dst, msg_id,
+  attempt)`` and injected at the socket boundary in
+  :class:`bcfl_tpu.dist.transport.PeerTransport`. This is the failure mode
+  real DCN actually exhibits — the lane the retry/dedup/CRC self-healing
+  transport is validated against (``scripts/dist_chaos.py``). The local
+  engine has no socket to inject at, so the capability table rejects the
+  lane on ``runtime="local"``.
 
 Everything is derived from ``(seed, fault lane, round)`` via
 ``np.random.default_rng`` — two engines with equal plans draw identical
@@ -82,6 +92,7 @@ _LANE_STRAGGLER = 2
 _LANE_CORRUPT = 3
 _LANE_PARTITION = 4
 _LANE_FLAKY = 5
+_LANE_WIRE = 6
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,6 +151,25 @@ class FaultPlan:
     flaky_burst_len: int = 3
     flaky_on_prob: float = 0.5
     flaky_scale: float = 1e6
+    # wire lane (runtime="dist" only): per-message socket-level faults,
+    # drawn per transmission attempt by `wire_actions`. `wire_drop_prob`
+    # loses the frame (the sender learns only via the missing ack),
+    # `wire_dup_prob` delivers a second copy (the receiver's dedup window
+    # must absorb it), `wire_reorder_prob` holds the frame for
+    # `wire_reorder_hold_s` at the receiver so later frames overtake it,
+    # `wire_delay_prob` sleeps a uniform [0, wire_delay_s) jitter before
+    # the send, and `wire_corrupt_prob` flips payload bytes in flight (the
+    # frame CRC must catch it). `wire_rounds` bounds the lane to a span of
+    # the sender's wire clock (None = every round) — the knob the
+    # "recovers when the chaos clears" legs use.
+    wire_drop_prob: float = 0.0
+    wire_dup_prob: float = 0.0
+    wire_reorder_prob: float = 0.0
+    wire_reorder_hold_s: float = 0.25
+    wire_delay_prob: float = 0.0
+    wire_delay_s: float = 0.2
+    wire_corrupt_prob: float = 0.0
+    wire_rounds: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         for name in ("dropout_prob", "straggler_prob", "corrupt_prob"):
@@ -244,6 +274,29 @@ class FaultPlan:
         if not np.isfinite(self.flaky_scale):
             raise ValueError("flaky_scale must be finite (same fingerprint-"
                              "poisoning concern as corrupt_scale)")
+        # --- wire lane ---
+        for name in ("wire_drop_prob", "wire_dup_prob", "wire_reorder_prob",
+                     "wire_delay_prob", "wire_corrupt_prob"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {p}")
+        for name in ("wire_reorder_hold_s", "wire_delay_s"):
+            v = getattr(self, name)
+            if v < 0 or not np.isfinite(v):
+                raise ValueError(f"{name} must be finite and >= 0, got {v}")
+        if self.wire_rounds is not None:
+            if not isinstance(self.wire_rounds, tuple):
+                raise ValueError("wire_rounds must be a tuple of round "
+                                 "indices (hashable — the plan lives inside "
+                                 "the frozen FedConfig)")
+            if not self.wire_rounds:
+                raise ValueError(
+                    "wire_rounds is empty: the wire lane would silently "
+                    "never fire (check the span bounds)")
+            if not self.wire_enabled:
+                raise ValueError(
+                    "wire_rounds without any wire_*_prob > 0 would "
+                    "silently never inject a wire fault")
 
     # ------------------------------------------------------------------ query
 
@@ -251,7 +304,14 @@ class FaultPlan:
     def enabled(self) -> bool:
         return (self.dropout_prob > 0 or self.straggler_prob > 0
                 or self.corrupt_prob > 0 or self.crash_at_round is not None
-                or self.partitions or self.churns or self.flaky_enabled)
+                or self.partitions or self.churns or self.flaky_enabled
+                or self.wire_enabled)
+
+    @property
+    def wire_enabled(self) -> bool:
+        return (self.wire_drop_prob > 0 or self.wire_dup_prob > 0
+                or self.wire_reorder_prob > 0 or self.wire_delay_prob > 0
+                or self.wire_corrupt_prob > 0)
 
     @property
     def partitions(self) -> bool:
@@ -391,6 +451,48 @@ class FaultPlan:
         row = np.where(flaky & (draw < self.flaky_on_prob),
                        self.flaky_scale, 0.0)
         return row.astype(np.float32) if row.any() else None
+
+    def wire_actions(self, rnd: int, src: int, dst: int, msg_id: int,
+                     attempt: int = 0) -> Optional[dict]:
+        """Socket-level fault draw for ONE transmission attempt of message
+        ``(src, dst, msg_id)`` while the sender's wire clock reads ``rnd``
+        (the peer's local round, the same clock the partition gate uses).
+        Returns None when the lane is off or not due this round, else a
+        dict of actions:
+
+        - ``drop``: lose this attempt's frame (no delivery, no ack),
+        - ``dup``: after a successful delivery, send a second copy,
+        - ``reorder_s``: > 0 — the receiver holds the frame this long
+          before enqueueing, letting later frames overtake it,
+        - ``delay_s``: pre-send jitter sleep,
+        - ``corrupt``: flip payload bytes after the CRC is computed,
+        - ``corrupt_pos``: fractions in [0, 1) choosing which bytes flip.
+
+        The draw includes ``attempt`` so a retried frame re-rolls its fate
+        — a ``wire_drop_prob < 1`` lane cannot black-hole a message forever
+        — while identical (clock, ids, attempt) coordinates always replay
+        the identical fault."""
+        if not self.wire_enabled or not self._due(self.wire_rounds, rnd):
+            return None
+        rng = self._wire_rng(rnd, src, dst, msg_id, attempt)
+        draw = rng.random(5)
+        delay = 0.0
+        if self.wire_delay_prob > 0 and draw[3] < self.wire_delay_prob:
+            delay = float(rng.random() * self.wire_delay_s)
+        return {
+            "drop": bool(draw[0] < self.wire_drop_prob),
+            "dup": bool(draw[1] < self.wire_dup_prob),
+            "reorder_s": (self.wire_reorder_hold_s
+                          if draw[2] < self.wire_reorder_prob else 0.0),
+            "delay_s": delay,
+            "corrupt": bool(draw[4] < self.wire_corrupt_prob),
+            "corrupt_pos": tuple(float(x) for x in rng.random(4)),
+        }
+
+    def _wire_rng(self, rnd: int, src: int, dst: int, msg_id: int,
+                  attempt: int) -> np.random.Generator:
+        return np.random.default_rng(
+            (self.seed, _LANE_WIRE, rnd, src, dst, msg_id, attempt))
 
 
 class FaultInjector:

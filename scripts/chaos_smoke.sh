@@ -140,4 +140,20 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/dist_async.py --peers 2 --rounds 6 --partition 2:4 \
     --no-kill --compress none --deadline 400 --idle-timeout 90 \
     --out /tmp/bcfl_chaos_dist_async.json
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Wire-chaos leg (RUNTIME.md "Delivery contract", ROBUSTNESS.md §7): the
+# same runtime with the FaultPlan wire lane active — seeded frame drop +
+# duplication + reorder at the socket boundary. The self-healing transport
+# (retry/backoff, CRC, per-sender dedup, failure detector) must complete
+# the run with zero double-merges; the full three-leg proof (corruption,
+# clean-baseline counters, SIGKILL quorum degradation) is
+# scripts/dist_chaos.py --legs wire,baseline,quorum.
+echo
+echo "wire-chaos leg: 2 peers, drop+dup+reorder active at the socket"
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/dist_chaos.py --peers 2 --rounds 6 --legs wire \
+    --wire-corrupt 0.0 --deadline 400 --idle-timeout 90 \
+    --out /tmp/bcfl_chaos_dist_chaos.json
 exit $?

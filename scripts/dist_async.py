@@ -126,6 +126,21 @@ def analyze(result, cfg, partitioned: bool, killed) -> dict:
         gates["killed_peer_resumed_from_checkpoint"] = bool(
             rep.get("resumed")) and rep.get("status") == "ok"
 
+    # per-peer transport observability (RUNTIME.md "Delivery contract"):
+    # the receiver-side partition drops and the self-healing counters —
+    # previously counted in-process but never surfaced into the artifact
+    transport = {
+        p: dict(
+            {k: (rep.get("transport") or {}).get(k, 0)
+             for k in ("retries", "send_failures", "dups_dropped",
+                       "crc_drops", "inbox_overflow", "circuit_skips")},
+            dropped_by_gate=rep.get("dropped_by_gate", 0),
+            detector_states=((rep.get("transport") or {}).get("detector")
+                             or {}).get("states"),
+        )
+        for p, rep in reports.items()
+    }
+
     return {
         "proof": "dist_async",
         "process_count": result["process_count"],
@@ -135,6 +150,7 @@ def analyze(result, cfg, partitioned: bool, killed) -> dict:
         "compress": cfg.compression.kind,
         "final_versions": {p: r.get("final_version")
                           for p, r in reports.items()},
+        "transport": transport,
         "staleness_distribution": hist,
         "staleness_samples": len(staleness),
         "arrival_latency_s": {

@@ -1,0 +1,384 @@
+"""Wire-chaos proof driver: the self-healing transport under adversarial
+delivery (RUNTIME.md "Delivery contract", ROBUSTNESS.md §7).
+
+Runs the multi-process dist runtime on CPU loopback through three legs and
+writes ``results/dist_chaos.json`` with hard pass/fail gates:
+
+**wire** — drop=0.2 / dup=0.2 / reorder=0.2 / corrupt=0.05 active at the
+socket boundary for the whole run. Gates: the run completes within its
+deadline; the merge count equals the unique ``(from, msg_id)`` count (zero
+double-merges — the at-least-once duplicates all died in the dedup
+window); nonzero ``retries``, ``dups_dropped`` and ``crc_drops`` counters
+(the chaos actually bit and the transport actually healed); at least one
+peer's failure detector transitioned through SUSPECT and back to
+REACHABLE; every ledger chain replica verifies end to end.
+
+**baseline** — the SAME config and seed with the wire lane disabled.
+Gates: the run completes with every counter only the chaos lane can
+drive (dups/crc/reorders/overflow) at exactly zero and the healing seam
+quiescent (no send failures, no open circuit, at most a startup-timing
+retry or two) — the lane is gated precisely by its knobs (PR 7's clean
+``dist_async`` behavior is reproduced; ``scripts/dist_async.py`` remains
+the full fork/heal/kill proof of that path).
+
+**quorum** — ``buffer = peers`` (every merge wants the full component) and
+one follower SIGKILLed after its first checkpoint, never restarted.
+Gates: the leader's failure detector marks the corpse DOWN, merges
+degrade to the reachable quorum (``degraded_merges > 0``) instead of
+paying ``buffer_timeout_s`` per merge forever, and the survivors complete
+within the deadline with verified chains.
+
+Wire faults are drawn from ``(seed, lane, round, src, dst, msg_id,
+attempt)`` — deterministic per message coordinate, but the realized
+message sequence depends on real concurrency, so the wire leg's fault
+COUNTS vary run to run around their expectations. With the default
+volume the probability of a zero count on any gated counter is well under
+1%; ``--wire-attempts`` retries the leg once (fresh chaos seed) before
+declaring failure, recording every attempt.
+
+Usage: python scripts/dist_chaos.py [--peers 3] [--rounds 10]
+           [--legs wire,baseline,quorum] [--deadline 600] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_cfg(args, wire: bool, chaos_seed: int, buffer: int = 0):
+    from bcfl_tpu.config import DistConfig, FedConfig, LedgerConfig, PartitionConfig
+    from bcfl_tpu.faults import FaultPlan
+
+    plan = FaultPlan()
+    if wire:
+        plan = FaultPlan(
+            seed=chaos_seed,
+            wire_drop_prob=args.wire_drop, wire_dup_prob=args.wire_dup,
+            wire_reorder_prob=args.wire_reorder,
+            wire_reorder_hold_s=0.2,
+            wire_delay_prob=args.wire_delay, wire_delay_s=0.1,
+            wire_corrupt_prob=args.wire_corrupt)
+    return FedConfig(
+        name="dist_chaos", runtime="dist", mode="server", sync="async",
+        model=args.model, dataset="synthetic",
+        num_clients=args.clients, num_rounds=args.rounds,
+        seq_len=args.seq_len, batch_size=args.batch_size,
+        max_local_batches=2, eval_every=0, seed=args.seed,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        faults=plan,
+        dist=DistConfig(
+            peers=args.peers, buffer=buffer,
+            buffer_timeout_s=args.buffer_timeout,
+            idle_timeout_s=args.idle_timeout,
+            peer_deadline_s=args.deadline,
+            checkpoint_every_versions=1,
+            # SUSPECT on the first failed attempt: makes the detector's
+            # transition log rich under a 20% drop rate without changing
+            # when the circuit opens (down_after)
+            suspect_after=1),
+        checkpoint_dir=None,
+    )
+
+
+def _merge_identity(reports: dict):
+    """(total merged arrivals, unique (leader, from, epoch, msg_id) count)
+    across every peer's merge log — equality is the zero-double-merge
+    gate. The identity matches the transport's full dedup key: scoped per
+    leader (two component leaders merging the same broadcast-era id is
+    not a double merge) and per sender incarnation (a restarted peer
+    legitimately reuses msg_id 0 under a new epoch)."""
+    total = 0
+    keys = set()
+    missing_ids = 0
+    for p, rep in reports.items():
+        for m in rep.get("merges") or []:
+            for a in m.get("arrivals") or []:
+                total += 1
+                if a.get("msg_id") is None:
+                    missing_ids += 1
+                else:
+                    keys.add((int(p), int(a["peer"]),
+                              int(a.get("msg_epoch") or 0),
+                              int(a["msg_id"])))
+    return total, len(keys), missing_ids
+
+
+def _suspect_roundtrip(reports: dict) -> bool:
+    """Did any peer's detector go ...-> SUSPECT -> ... -> REACHABLE for
+    the same target peer?"""
+    for rep in reports.values():
+        trans = ((rep.get("transport") or {}).get("detector") or {}).get(
+            "transitions") or []
+        suspected = set()
+        for t in trans:
+            if t["to"] == "suspect":
+                suspected.add(t["peer"])
+            elif t["to"] == "reachable" and t["peer"] in suspected:
+                return True
+    return False
+
+
+def _tsum(reports: dict, key: str) -> int:
+    return sum((rep.get("transport") or {}).get(key) or 0
+               for rep in reports.values())
+
+
+def run_wire_leg(args, chaos_seed: int) -> dict:
+    from bcfl_tpu.dist.harness import run_dist
+
+    cfg = build_cfg(args, wire=True, chaos_seed=chaos_seed)
+    run_dir = os.path.join("/tmp", f"bcfl_dist_chaos_wire_{os.getpid()}_"
+                                   f"{chaos_seed}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform)
+    reports = result["reports"]
+    total, unique, missing = _merge_identity(reports)
+    gates = {
+        "completed_within_deadline": (
+            result["ok"] and len(reports) == args.peers),
+        "zero_double_merges": (total == unique and missing == 0
+                               and total > 0),
+        "chains_verify": bool(reports) and all(
+            rep.get("chain_ok") in (True, None)
+            for rep in reports.values()),
+    }
+    # counter gates only for the probabilities actually armed (the smoke
+    # leg runs drop+dup+reorder with corruption off, for example)
+    lossy = args.wire_drop > 0 or args.wire_corrupt > 0
+    if lossy:
+        gates["retries_nonzero"] = _tsum(reports, "retries") > 0
+        gates["detector_suspect_roundtrip"] = _suspect_roundtrip(reports)
+    if args.wire_dup > 0:
+        gates["dups_dropped_nonzero"] = _tsum(reports, "dups_dropped") > 0
+    if args.wire_corrupt > 0:
+        gates["crc_drops_nonzero"] = _tsum(reports, "crc_drops") > 0
+    if args.wire_reorder > 0:
+        gates["reorders_held_nonzero"] = (
+            _tsum(reports, "reorders_held") > 0)
+    return {
+        "leg": "wire", "chaos_seed": chaos_seed,
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "merged_arrivals": total, "unique_update_ids": unique,
+        "transport": {p: rep.get("transport")
+                      for p, rep in reports.items()},
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+        "log_tails": None if all(gates.values()) else result["log_tails"],
+    }
+
+
+def run_baseline_leg(args) -> dict:
+    from bcfl_tpu.dist.harness import run_dist
+
+    cfg = build_cfg(args, wire=False, chaos_seed=args.chaos_seed)
+    run_dir = os.path.join("/tmp", f"bcfl_dist_chaos_base_{os.getpid()}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform)
+    reports = result["reports"]
+    total, unique, missing = _merge_identity(reports)
+    # with the lane disabled the chaos machinery must be provably idle:
+    # counters only the wire lane can drive are exactly zero. Plain
+    # startup-timing retries (peer A's first send racing peer B's
+    # listener on a loaded host) are the healing seam doing its job, so
+    # `retries` gets a small allowance instead of hard zero — but they
+    # must all have healed (no send_failures, no open circuit).
+    counters = {k: _tsum(reports, k)
+                for k in ("retries", "send_failures", "dups_dropped",
+                          "crc_drops", "wire_drops", "inbox_overflow",
+                          "reorders_held", "circuit_skips")}
+    gates = {
+        "completed_within_deadline": (
+            result["ok"] and len(reports) == args.peers),
+        "zero_double_merges": (total == unique and missing == 0
+                               and total > 0),
+        "chaos_counters_all_zero": all(
+            counters[k] == 0
+            for k in ("dups_dropped", "crc_drops", "wire_drops",
+                      "reorders_held", "inbox_overflow")),
+        # retries/send_failures get a small allowance: besides startup
+        # timing, a follower's final end-of-round update can race the
+        # leader's post-finalize transport close (connection refused,
+        # retries exhaust) — a legitimate shutdown-window artifact, not a
+        # transport defect. An open circuit would need down_after
+        # consecutive failures and stays a hard zero.
+        "healing_quiescent": (
+            counters["retries"] <= args.peers * 4
+            and counters["send_failures"] <= args.peers - 1
+            and counters["circuit_skips"] == 0),
+        "chains_verify": bool(reports) and all(
+            rep.get("chain_ok") in (True, None)
+            for rep in reports.values()),
+    }
+    return {
+        "leg": "baseline",
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "transport_counters": counters,
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+        "log_tails": None if all(gates.values()) else result["log_tails"],
+    }
+
+
+def run_quorum_leg(args) -> dict:
+    from bcfl_tpu.dist.harness import run_dist
+
+    # buffer = peers: every merge wants the whole component, so a dead
+    # peer would stall every merge on buffer_timeout_s — the exact
+    # pathology quorum degradation removes
+    cfg = build_cfg(args, wire=False, chaos_seed=args.chaos_seed,
+                    buffer=args.peers)
+    run_dir = os.path.join("/tmp", f"bcfl_dist_chaos_quorum_{os.getpid()}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    victim = args.peers - 1  # a follower: the leader must survive
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform, kill_peer=victim,
+                      restart_killed=False)
+    reports = result["reports"]
+    survivors = [p for p in range(args.peers) if p != victim]
+    leader = reports.get(0, {})
+    det = ((leader.get("transport") or {}).get("detector") or {})
+    gates = {
+        "survivors_completed": all(
+            reports.get(p, {}).get("status") == "ok" for p in survivors),
+        "victim_killed_not_restarted": (
+            result.get("kill") is not None
+            and not result["kill"]["restarted"]
+            and result["returncodes"].get(str(victim)) not in (0, None)),
+        "leader_marked_victim_down": (
+            det.get("states", {}).get(str(victim)) == "down"),
+        "degraded_merges_recorded": (
+            (leader.get("degraded_merges") or 0) > 0),
+        "target_versions_reached": (
+            (leader.get("final_version") or 0) >= args.rounds),
+        "chains_verify": all(
+            reports.get(p, {}).get("chain_ok") in (True, None)
+            for p in survivors),
+    }
+    return {
+        "leg": "quorum", "victim": victim,
+        "kill": result.get("kill"),
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "degraded_merges": leader.get("degraded_merges"),
+        "below_quorum_events": leader.get("below_quorum_events"),
+        "leader_detector": det,
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+        "log_tails": None if all(gates.values()) else result["log_tails"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=None,
+                    help="default: 2 per peer")
+    ap.add_argument("--rounds", type=int, default=10,
+                    help="global model versions the leader must produce "
+                         "(also the wire leg's chaos-draw volume knob)")
+    ap.add_argument("--model", default="tiny-bert")
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--wire-drop", type=float, default=0.2)
+    ap.add_argument("--wire-dup", type=float, default=0.2)
+    ap.add_argument("--wire-reorder", type=float, default=0.2)
+    ap.add_argument("--wire-delay", type=float, default=0.2)
+    ap.add_argument("--wire-corrupt", type=float, default=0.05)
+    ap.add_argument("--wire-attempts", type=int, default=2,
+                    help="wire-leg attempts before declaring failure "
+                         "(fresh chaos seed per attempt; counts are "
+                         "probabilistic, see module docstring)")
+    ap.add_argument("--legs", default="wire,baseline,quorum",
+                    help="comma subset of wire,baseline,quorum")
+    ap.add_argument("--buffer-timeout", type=float, default=10.0)
+    ap.add_argument("--deadline", type=float, default=600.0)
+    ap.add_argument("--idle-timeout", type=float, default=120.0)
+    ap.add_argument("--platform", default=os.environ.get("JAX_PLATFORMS")
+                    or "cpu")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT, "results",
+                                                  "dist_chaos.json"))
+    args = ap.parse_args(argv)
+    if args.clients is None:
+        args.clients = 2 * args.peers
+    legs = [s.strip() for s in args.legs.split(",") if s.strip()]
+    bad = [s for s in legs if s not in ("wire", "baseline", "quorum")]
+    if bad:
+        print(f"unknown legs {bad}", file=sys.stderr)
+        return 2
+
+    record = {"proof": "dist_chaos", "peers": args.peers,
+              "clients": args.clients, "target_versions": args.rounds,
+              "wire_probs": {"drop": args.wire_drop, "dup": args.wire_dup,
+                             "reorder": args.wire_reorder,
+                             "delay": args.wire_delay,
+                             "corrupt": args.wire_corrupt},
+              "legs": {}}
+    t0 = time.time()
+    for leg in legs:
+        print(f"dist_chaos: running leg '{leg}' "
+              f"({args.peers} peers x {args.clients // args.peers} "
+              f"clients, target {args.rounds} versions)", flush=True)
+        if leg == "wire":
+            attempts = []
+            for i in range(max(args.wire_attempts, 1)):
+                out = run_wire_leg(args, chaos_seed=args.chaos_seed + i)
+                attempts.append(out)
+                if out["ok"]:
+                    break
+            out = attempts[-1]
+            out["attempts"] = len(attempts)
+            if len(attempts) > 1:
+                out["prior_attempt_gates"] = [a["gates"]
+                                              for a in attempts[:-1]]
+        elif leg == "baseline":
+            out = run_baseline_leg(args)
+        else:
+            out = run_quorum_leg(args)
+        record["legs"][leg] = out
+        print(json.dumps({"leg": leg, "gates": out["gates"],
+                          "wall_s": out["wall_s"]}, indent=2), flush=True)
+    record["ok"] = all(leg["ok"] for leg in record["legs"].values())
+    record["wall_s"] = time.time() - t0
+    record["recorded_at"] = int(time.time())
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    if not record["ok"]:
+        for name, leg in record["legs"].items():
+            for p, tail in (leg.get("log_tails") or {}).items():
+                print(f"--- {name} peer {p} log tail ---\n{tail}",
+                      flush=True)
+        print(f"dist_chaos FAILED (evidence in {args.out})", flush=True)
+        return 1
+    print(f"dist_chaos OK in {record['wall_s']:.1f}s -> {args.out}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

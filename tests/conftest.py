@@ -38,7 +38,7 @@ import pytest  # noqa: E402
 
 @pytest.fixture(scope="session", autouse=True)
 def _reap_dist_peers():
-    """Orphan reaper for the dist runtime (RUNTIME.md §5): any peer
+    """Orphan reaper for the dist runtime (RUNTIME.md §7): any peer
     subprocess a dist test spawned and failed to collect — a hung peer, an
     interrupted harness — is SIGKILLed at session teardown, so a straggler
     can never squat on the tier-1 870 s window or outlive the CI job. The

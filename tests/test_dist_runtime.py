@@ -18,6 +18,7 @@ from bcfl_tpu.dist.harness import _LIVE, free_ports, reap_all
 from bcfl_tpu.dist.launch import cfg_from_json, cfg_to_json
 from bcfl_tpu.dist.transport import PartitionGate, PeerTransport
 from bcfl_tpu.dist.wire import (
+    PREFIX_LEN,
     WireError,
     pack_frame,
     read_frame,
@@ -42,7 +43,8 @@ def _tree():
 
 def test_frame_roundtrip_bitexact():
     header = {"type": "update", "base_version": 3, "n_ex": [5, 7]}
-    hdr, trees = unpack_frame(pack_frame(header, {"payload": _tree()})[12:])
+    hdr, trees = unpack_frame(
+        pack_frame(header, {"payload": _tree()})[PREFIX_LEN:])
     assert hdr == header
     for path in ("layer", "head"):
         for k, v in _tree()[path].items():
@@ -58,7 +60,7 @@ def test_payload_keys_with_slashes_keep_structure():
     # wire must not silently re-nest them (that broke the decode lookup)
     payload = {"layer/kernel": {"q": np.int8([[1, 2]]),
                                 "s": np.float32([[0.5]])}}
-    _, trees = unpack_frame(pack_frame({}, {"p": payload})[12:])
+    _, trees = unpack_frame(pack_frame({}, {"p": payload})[PREFIX_LEN:])
     assert set(trees["p"]) == {"layer/kernel"}
     np.testing.assert_array_equal(trees["p"]["layer/kernel"]["q"],
                                   payload["layer/kernel"]["q"])
@@ -67,7 +69,7 @@ def test_payload_keys_with_slashes_keep_structure():
 def test_truncated_and_bad_magic_fail_loudly():
     frame = pack_frame({"a": 1}, {"t": _tree()})
     with pytest.raises(WireError):
-        unpack_frame(frame[12:-3])  # truncated body
+        unpack_frame(frame[PREFIX_LEN:-3])  # truncated body
     # bad magic via the socket reader
     port = free_ports(1)[0]
     srv = socket.socket()
@@ -162,9 +164,13 @@ def test_capability_table_is_total_and_enforced():
     # every row resolves to supported (True) or a declared reason (str)
     for feature, active, verdict in rows:
         assert verdict is True or (isinstance(verdict, str) and verdict)
-    # the local runtime supports everything the table lists
-    for _, _, verdict in capability_table(FedConfig()):
-        assert verdict is True
+    # the local runtime supports everything except the wire lane — the one
+    # feature that only exists at a real socket boundary
+    for feature, _, verdict in capability_table(FedConfig()):
+        if feature.startswith("chaos: wire"):
+            assert isinstance(verdict, str) and "socket" in verdict
+        else:
+            assert verdict is True
 
 
 @pytest.mark.parametrize("kw,needle", [
@@ -177,7 +183,7 @@ def test_capability_table_is_total_and_enforced():
     (dict(aggregator="krum"), "order statistics"),
     (dict(registry_size=100, sample_clients=4), "registry"),
     (dict(faults=FaultPlan(dropout_prob=0.5)), "dropout"),
-    (dict(faults=FaultPlan(corrupt_prob=0.5)), "corrupt"),
+    (dict(faults=FaultPlan(corrupt_prob=0.5)), "wire lane"),
     (dict(faults=FaultPlan(crash_at_round=1)), "crash"),
 ])
 def test_dist_rejections_come_from_the_table(kw, needle):
@@ -205,6 +211,18 @@ def test_dist_supported_combinations_construct():
                                partition_rounds=(1, 2)))
 
 
+def test_wire_lane_is_dist_only():
+    # the wire lane composes on dist (with the partition lane too) ...
+    cfg = _dist_cfg(faults=FaultPlan(
+        wire_drop_prob=0.2, wire_dup_prob=0.2, wire_corrupt_prob=0.05,
+        partition_groups=((0,), (1,)), partition_rounds=(2, 3)))
+    assert cfg.faults.wire_enabled
+    # ... and is rejected on the local runtime with the table's reason
+    with pytest.raises(ValueError, match="not supported on runtime="
+                                         "'local'.*socket"):
+        FedConfig(faults=FaultPlan(wire_drop_prob=0.2))
+
+
 def test_local_configs_unchanged_by_runtime_axis():
     # the default is local and the new axis adds no field the old surface
     # didn't have defaults for — an existing config constructs identically
@@ -220,7 +238,11 @@ def test_cfg_json_roundtrip_for_peer_processes():
         ledger=LedgerConfig(enabled=True),
         compression=CompressionConfig(kind="topk", topk_frac=0.1),
         faults=FaultPlan(partition_groups=((0,), (1,)),
-                         partition_rounds=(2, 3)))
+                         partition_rounds=(2, 3),
+                         wire_drop_prob=0.2, wire_dup_prob=0.1,
+                         wire_rounds=(0, 1, 2)),
+        dist=DistConfig(peers=2, quorum_frac=0.67, suspect_after=1,
+                        dedup_window=64, inbox_max=128))
     assert cfg_from_json(cfg_to_json(cfg)) == cfg
 
 

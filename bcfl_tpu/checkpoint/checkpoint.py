@@ -45,11 +45,14 @@ import json
 import logging
 import os
 import shutil
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from bcfl_tpu.telemetry import events as _telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -103,6 +106,7 @@ def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
     mismatching digest — on re-save of an existing round the stale meta is
     deleted before the old tree is disturbed, so the digest check rejects
     only genuine corruption."""
+    _t0 = time.perf_counter()
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     name = f"round_{round_idx:06d}"
@@ -137,6 +141,11 @@ def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
         os.fsync(f.fileno())
     os.replace(meta_staging, meta_path)
     _fsync_dir(directory)
+    # one typed event per committed checkpoint (a no-op without an
+    # installed writer): crash/rejoin analysis over the merged timeline
+    # needs to know which versions were durable when
+    _telemetry.emit("ckpt.save", step=int(round_idx), dir=directory,
+                    wall_s=time.perf_counter() - _t0)
     return final
 
 
@@ -193,5 +202,6 @@ def restore_latest(directory: str) -> Optional[Tuple[int, Dict[str, Any], Option
             if os.path.exists(legacy):
                 with open(legacy) as f:
                     ledger_json = f.read()
+        _telemetry.emit("ckpt.restore", step=int(r), dir=directory)
         return r, state, ledger_json
     return None

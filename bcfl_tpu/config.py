@@ -155,6 +155,12 @@ class DistConfig:
     # `inbox_overflow`), so the sender's retry can still deliver it once
     # the inbox drains — at-least-once survives a full inbox
     inbox_max: int = 1024
+    # partial-report cadence: peers rewrite their report_peer*.json every
+    # N local rounds (and on every adopted/produced version, at startup,
+    # and on SIGTERM) with status="running" — a SIGKILLed or stalled peer
+    # leaves a current partial report instead of nothing. 0 disables the
+    # periodic rewrites (startup/terminal writes remain).
+    report_every_rounds: int = 5
     # quorum degradation: the FedBuff leader's buffer target counts only
     # component peers the detector does NOT hold DOWN (merges recorded as
     # degraded while any are), and below this reachable fraction of the
@@ -188,6 +194,10 @@ class DistConfig:
         for name in ("dedup_window", "inbox_max"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
+        if self.report_every_rounds < 0:
+            raise ValueError(
+                f"report_every_rounds must be >= 0, got "
+                f"{self.report_every_rounds}")
         if not 0.0 < self.quorum_frac <= 1.0:
             raise ValueError(
                 f"quorum_frac must be in (0, 1], got {self.quorum_frac}")
@@ -539,6 +549,24 @@ class FedConfig:
     # The reference's only profiling is psutil+wall-clock (SURVEY.md §5).
     profile_dir: Optional[str] = None
 
+    # --- event telemetry (OBSERVABILITY.md) ---
+    # crash-safe per-process JSONL event streams (bcfl_tpu.telemetry):
+    # round/phase spans, transport send/retry/ack/dedup, failure-detector
+    # transitions, chaos injections, FedBuff merge lineage, ledger
+    # commit/fork/heal, checkpoint and reputation events — collated into
+    # one causally-ordered timeline by `bcfl-tpu trace`.
+    #   None  = the dist runtime streams into its run dir (telemetry is
+    #           how chaos runs are gated, so it defaults ON there); the
+    #           local engine emits nothing,
+    #   "off" = disabled everywhere (the overhead-measurement setting),
+    #   path  = stream into this directory on both runtimes.
+    telemetry_dir: Optional[str] = None
+    # deterministic sampling rate in [0, 1] for HIGH-RATE transport events
+    # (per-attempt outcomes, chaos draws). Invariant-grade events (final
+    # send outcomes, receive dispositions, merge lineage) are never
+    # sampled — the invariant checks stay exact at any setting.
+    telemetry_sample: float = 1.0
+
     def __post_init__(self):
         if self.runtime not in ("local", "dist"):
             raise ValueError(f"unknown runtime: {self.runtime!r}")
@@ -578,6 +606,10 @@ class FedConfig:
             # 0 = never evaluate (pure-throughput runs); negative cadences
             # would silently produce modulo surprises
             raise ValueError(f"eval_every must be >= 0, got {self.eval_every}")
+        if not 0.0 <= self.telemetry_sample <= 1.0:
+            raise ValueError(
+                f"telemetry_sample must be in [0, 1], got "
+                f"{self.telemetry_sample}")
         if self.task not in ("classification", "causal_lm"):
             raise ValueError(f"unknown task: {self.task!r}")
         if self.prng_impl not in (None, "threefry", "rbg", "unsafe_rbg"):

@@ -192,6 +192,13 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
     ok = (all(rc == 0 for rc in rcs.values())
           and all(reports.get(p, {}).get("status") == "ok"
                   for p in range(n)))
+    # per-peer telemetry streams (OBSERVABILITY.md): collate with
+    # bcfl_tpu.telemetry.collate / `bcfl-tpu trace`. Scanned via the
+    # same resolver the peers write through, so the two can't drift
+    from bcfl_tpu.telemetry import find_streams, resolve_stream_dir
+
+    tele_dir = resolve_stream_dir(cfg.telemetry_dir, run_dir)
+
     return {
         "ok": ok,
         "process_count": n,
@@ -200,5 +207,7 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
         "log_tails": logs,
         "kill": kill_record,
         "run_dir": run_dir,
+        "event_streams": (find_streams(tele_dir)
+                          if tele_dir is not None else []),
         "wall_s": time.time() - t0,
     }

@@ -36,6 +36,17 @@ The essentials, and how they map onto the existing machinery:
 - **Nothing can wedge**: a hard per-process deadline, an idle watchdog (no
   version progress), and a parent-death check each force a nonzero exit,
   and the spawning harness reaps stragglers.
+- **Everything is traced** (OBSERVABILITY.md): each peer writes an
+  append-only ``events_peer{p}.jsonl`` stream (bcfl_tpu.telemetry) —
+  train-round spans, transport send/recv/detector/chaos events, FedBuff
+  merges with full lineage (which ``(peer, msg_epoch, msg_id)`` updates at
+  what measured staleness and weight composed each version), ledger
+  commit/fork/heal, checkpoint and quorum events — which ``bcfl-tpu
+  trace`` collates into one causally-ordered cross-peer timeline and
+  checks the delivery-contract invariants against. The peer also rewrites
+  its JSON report periodically (``DistConfig.report_every_rounds``) and on
+  SIGTERM, so a killed or stalled peer leaves a current partial report
+  instead of nothing.
 """
 
 from __future__ import annotations
@@ -44,11 +55,14 @@ import dataclasses
 import json
 import logging
 import os
+import signal
 import threading
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from bcfl_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -97,6 +111,21 @@ class PeerRuntime:
         self.peers = cfg.dist.peers
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
+        # per-process event stream (OBSERVABILITY.md): ON by default for
+        # the dist runtime — the chaos proofs and their invariant gates
+        # are queries over these streams. telemetry_dir="off" disables
+        # (the overhead-measurement setting); a path overrides the run
+        # dir. Installed before the transport exists so its serve threads
+        # always see the writer.
+        self.events_path = None
+        stream_dir = telemetry.resolve_stream_dir(cfg.telemetry_dir,
+                                                  run_dir)
+        if stream_dir is not None:
+            self.events_path = os.path.join(
+                stream_dir, f"events_peer{self.peer_id}.jsonl")
+            telemetry.install(telemetry.EventWriter(
+                self.events_path, peer=self.peer_id, run=cfg.name,
+                sample=cfg.telemetry_sample))
         k = cfg.num_clients // self.peers
         self.local_clients = k
         self.global_ids = np.arange(self.peer_id * k, (self.peer_id + 1) * k)
@@ -179,6 +208,23 @@ class PeerRuntime:
             cfg.dist.peer_deadline_s, self._deadline_fire)
         self._deadline_timer.daemon = True
         self._deadline_timer.start()
+        # partial-report cadence (report_every_rounds): what the report
+        # loop compares against to decide a periodic rewrite is due.
+        # Reentrant lock: the deadline Timer thread, the main loop's
+        # periodic flush, and the SIGTERM handler (which interrupts the
+        # main thread mid-frame) all write the same report file.
+        self._report_round = -1
+        self._report_version = -1
+        self._report_lock = threading.RLock()
+        self._report_terminal = False
+        self._chain_ok_cache: Optional[bool] = None
+        # SIGTERM leaves a current report + flushed event stream behind
+        # (SIGKILL cannot be caught — there the periodic rewrites are the
+        # whole story). Registered in the peer's main thread.
+        try:
+            signal.signal(signal.SIGTERM, self._sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded/test use): skip
 
     # ------------------------------------------------------------- watchdogs
 
@@ -187,6 +233,29 @@ class PeerRuntime:
                      self.peer_id, self.cfg.dist.peer_deadline_s)
         self._write_report(status="deadline")
         os._exit(3)
+
+    def _sigterm(self, signum, frame):
+        logger.error("peer %d: SIGTERM; writing final partial report",
+                     self.peer_id)
+        try:
+            self._write_report(status="sigterm")
+        finally:
+            # unconditional: a reentrancy hiccup in the report/telemetry
+            # write must not swallow the termination itself
+            os._exit(7)
+
+    def _maybe_flush_report(self):
+        """Periodic partial-report rewrite: every ``report_every_rounds``
+        local rounds and on every version change — a SIGKILLed peer's
+        newest report is at most one cadence stale, instead of absent.
+        ``report_every_rounds=0`` is the documented off-switch for ALL
+        mid-run rewrites (startup/terminal writes remain)."""
+        every = self.cfg.dist.report_every_rounds
+        due = every > 0 and (
+            self.version != self._report_version
+            or self.local_round - self._report_round >= every)
+        if due:
+            self._write_report(status="running")
 
     def _check_watchdogs(self):
         if os.getppid() != self._ppid:
@@ -251,6 +320,7 @@ class PeerRuntime:
 
         cfg = self.cfg
         rnd = self.local_round
+        t0 = time.time()
         tree, n_ex = client_batches(
             self.eng.cache, self.eng.partitioner, self.global_ids, rnd,
             cfg.batch_size, max_batches=cfg.max_local_batches)
@@ -277,6 +347,8 @@ class PeerRuntime:
         }
         wire_tree = jax.tree.map(np.asarray, jax.device_get(ex.sent))
         self.local_round += 1
+        telemetry.emit("round", round=rnd, wall_s=time.time() - t0,
+                       base_version=int(self.version))
 
         # chaos straggler lane, driven for REAL at the transport: the
         # injected delay is an actual pre-send sleep, so it shows up in the
@@ -339,6 +411,8 @@ class PeerRuntime:
             if not self._below_quorum:
                 self._below_quorum = True
                 self._below_quorum_events += 1
+                telemetry.emit("quorum.below", component=len(comp),
+                               alive=len(alive), down=list(down))
             # with merges (and so broadcasts) parked, nothing else on the
             # leader sends — so nothing would ever probe the DOWN peers
             # and the below-quorum state would be ABSORBING even after
@@ -376,6 +450,18 @@ class PeerRuntime:
             quorum=({"component": len(comp), "alive": len(alive),
                      "down": down} if down else None))
         self.merges.append(rec)
+        # the FedBuff lineage event (OBSERVABILITY.md): which (peer,
+        # msg_epoch, msg_id) updates, at what measured staleness and
+        # merge weight, composed this model version — plus the chain
+        # state it committed, for the monotone-heads invariant
+        telemetry.emit(
+            "merge", version=rec.version, leader=rec.leader,
+            arrivals=rec.arrivals, rejected=rec.rejected, solo=rec.solo,
+            degraded=rec.degraded, component=list(comp),
+            quorum=rec.quorum, wall_s=rec.wall_s,
+            **({"chain_len": len(self.chain),
+                "head8": self.chain.head.hex()[:16], "rewrite": False}
+               if self.chain is not None else {}))
         self._maybe_checkpoint()
         self._broadcast_global(healed=False)
 
@@ -420,6 +506,10 @@ class PeerRuntime:
                 self.chain.append_digest(int(header["round"]), int(c),
                                          bytes.fromhex(d),
                                          self.eng._client_payload_bytes)
+            telemetry.emit("ledger", op="commit", round=int(header["round"]),
+                           n=self.local_clients, chain_len=len(self.chain),
+                           rewrite=False,
+                           head8=self.chain.head.hex()[:16])
             fp = np.asarray(self.eng.progs.fingerprint(dev))
             for c in range(self.local_clients):
                 recomputed = self.eng._entry_digest(kind, fp[c]).hex()
@@ -447,6 +537,11 @@ class PeerRuntime:
         if float(alpha.sum()) <= 0.0:
             rec["rejected"] = "all clients eliminated (auth)"
             return {"ok": False, "rec": rec}
+        # the update's total merge weight (staleness decay x examples x
+        # auth, summed over the peer's client slice): part of the merge
+        # lineage — every composed model version is reconstructible from
+        # the stream
+        rec["weight"] = float(alpha.sum())
         return {"ok": True, "rec": rec, "deltas": deltas, "alpha": alpha,
                 "base_w": float(base_w.sum())}
 
@@ -496,6 +591,8 @@ class PeerRuntime:
             self._last_broadcast_len = len(self.chain)
         else:
             header["chain"] = None
+        telemetry.emit("broadcast", version=int(self.version),
+                       healed=bool(healed), full=bool(healed or full))
         model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
         for p in self._component():
             if p == self.peer_id:
@@ -518,6 +615,10 @@ class PeerRuntime:
                 "head_at_fork": self._head(),
                 "component": list(self.gate.component_of(self.peer_id)),
             }
+            telemetry.emit("fork.begin", at_version=int(self.version),
+                           component=self.fork["component"],
+                           head8=(self._head() or "")[:16],
+                           fork_base=self.fork["fork_base"])
             logger.info("peer %d: partition began at version %d "
                         "(component %s)", self.peer_id, self.version,
                         self.fork["component"])
@@ -531,6 +632,8 @@ class PeerRuntime:
             if min(old_comp) == self.peer_id and self.peer_id != 0:
                 # I led a fork component: initiate the reconcile handshake
                 self._pending_reconcile = True
+            telemetry.emit("fork.heal", at_version=int(self.version),
+                           head8=(self._head() or "")[:16])
             logger.info("peer %d: partition healed at version %d (head %s)",
                         self.peer_id, self.version,
                         (self._head() or "")[:16])
@@ -605,6 +708,12 @@ class PeerRuntime:
             rec["merged_entries"] = len(merged)
             rec["merged_head"] = self._head()
             rec["chain_ok"] = (self.chain.verify_chain() == -1)
+            # a declared history rewrite: the monotone-heads invariant
+            # treats this (and only this kind of) length change as legal
+            telemetry.emit("ledger", op="adopt_merge",
+                           chain_len=len(self.chain), rewrite=True,
+                           head8=(self._head() or "")[:16],
+                           fork_point=fork)
         # model consensus across the healed components: the participation-
         # weighted mean of the two fork models (with aggregator pinned to
         # "mean" on this runtime, this IS what the collapse consensus
@@ -620,6 +729,7 @@ class PeerRuntime:
         rec["healed_version"] = int(self.version)
         rec["wall_s"] = time.time() - t0
         self.reconcile = rec
+        telemetry.emit("reconcile", **rec)
         self._maybe_checkpoint()
         self._broadcast_global(healed=True)
         logger.info("peer %d: reconciled fork from peer %d -> version %d "
@@ -668,6 +778,11 @@ class PeerRuntime:
                     return
                 self.chain = replica
                 self.eng.ledger = replica
+                # full replica rebuild: a declared rewrite (heal / hello
+                # resync may shorten a fork replica's chain legitimately)
+                telemetry.emit("ledger", op="resync",
+                               chain_len=len(self.chain), rewrite=True,
+                               head8=self.chain.head.hex()[:16])
             elif (start == len(self.chain)
                   and self.chain.head.hex() == header.get("chain_prev_head")):
                 # contiguous suffix: verify incrementally as it lands
@@ -677,6 +792,9 @@ class PeerRuntime:
                                  version)
                     self._request_resync(int(header["from"]))
                     return
+                telemetry.emit("ledger", op="append",
+                               chain_len=len(self.chain), rewrite=False,
+                               head8=self.chain.head.hex()[:16])
             else:
                 # gap or diverged base (missed broadcasts, fork rewrite):
                 # never adopt a model whose chain this replica can't
@@ -687,6 +805,9 @@ class PeerRuntime:
         self.version = version
         self.adopted.append(version)
         self._note_version()
+        telemetry.emit("adopt", version=version,
+                       healed=bool(header.get("healed")),
+                       leader=int(header.get("from", -1)))
         if header.get("healed"):
             # ONLY the healed global clears a pending offer: it is the one
             # broadcast that provably incorporated this peer's fork
@@ -828,13 +949,20 @@ class PeerRuntime:
         logger.info("peer %d/%d up: clients %s, version %d%s",
                     self.peer_id, self.peers, list(self.global_ids),
                     self.version, " (resumed)" if self._resumed else "")
+        telemetry.emit("run.start", role="peer", peers=self.peers,
+                       resumed=self._resumed, version=int(self.version),
+                       epoch=self.transport.epoch)
         self.transport.start()
+        # an immediate partial report: from this instant on, even a peer
+        # SIGKILLed seconds into the run leaves evidence behind
+        self._write_report(status="running")
         if self._resumed and self.peer_id != 0:
             self.transport.send(0, {"type": "hello",
                                     "version": int(self.version)})
         try:
             while not self._stop:
                 self._check_watchdogs()
+                self._maybe_flush_report()
                 msg = self.transport.recv(timeout_s=0.05)
                 while msg is not None:
                     self._handle(*msg)
@@ -878,6 +1006,33 @@ class PeerRuntime:
     # ---------------------------------------------------------------- report
 
     def _write_report(self, status: str):
+        """Atomic (tmp + rename) report write. ``status="running"`` is the
+        periodic partial flush — the report a SIGKILLed peer leaves
+        behind; any other status is terminal and also closes out the
+        event stream (run.end + flush), so a cleanly-ended stream is a
+        complete record.
+
+        Serialized under a reentrant lock (watchdog Timer thread, main
+        loop, SIGTERM handler share the tmp file), and terminal statuses
+        win: once one is written, a periodic "running" rewrite can never
+        overwrite it."""
+        with self._report_lock:
+            if self._report_terminal:
+                return
+            if status != "running":
+                self._report_terminal = True
+            self._write_report_locked(status)
+
+    def _chain_ok(self, status: str) -> Optional[bool]:
+        if self.chain is None:
+            return None
+        if status != "running" or self._chain_ok_cache is None:
+            self._chain_ok_cache = self.chain.verify_chain() == -1
+        return self._chain_ok_cache
+
+    def _write_report_locked(self, status: str):
+        self._report_round = self.local_round
+        self._report_version = self.version
         staleness = [a["staleness"] for m in self.merges for a in m.arrivals]
         latencies = [a["latency_s"] for m in self.merges for a in m.arrivals]
         tstats = self.transport.stats()
@@ -904,9 +1059,13 @@ class PeerRuntime:
             "reconcile": self.reconcile,
             "chain_len": len(self.chain) if self.chain is not None else None,
             "chain_head": self._head(),
-            "chain_ok": (self.chain.verify_chain() == -1
-                         if self.chain is not None else None),
+            # verify_chain re-hashes the WHOLE ledger — O(chain) per call,
+            # quadratic if run on every periodic flush. Full verify on
+            # terminal writes only; periodic reports carry the last
+            # verified verdict (refreshed at startup and at exit)
+            "chain_ok": self._chain_ok(status),
             "final_eval": getattr(self, "_final_eval", None),
+            "events": self.events_path,
             "wall_s": time.time() - self._t0,
         }
         path = os.path.join(self.run_dir, f"report_peer{self.peer_id}.json")
@@ -914,6 +1073,16 @@ class PeerRuntime:
         with open(tmp, "w") as f:
             json.dump(report, f, indent=2)
         os.replace(tmp, path)
+        telemetry.emit("report.flush", status=status)
+        if status != "running":
+            # terminal: run.end marks the stream cleanly closed (the
+            # acked_not_lost invariant only judges receivers bearing this
+            # mark), and the flush makes it durable even on the os._exit
+            # watchdog paths, which skip atexit hooks
+            telemetry.emit("run.end", status=status,
+                           version=int(self.version),
+                           local_rounds=int(self.local_round))
+        telemetry.flush()
 
 
 def peer_main(argv=None) -> int:

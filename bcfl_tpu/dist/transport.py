@@ -63,6 +63,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from bcfl_tpu.config import DistConfig
+from bcfl_tpu.telemetry import events as _telemetry
 from bcfl_tpu.dist.wire import (
     PREFIX_LEN,
     CrcError,
@@ -125,6 +126,10 @@ class FailureDetector:
         self.transitions.append(
             {"peer": int(peer), "from": old, "to": state,
              "at": time.time()})
+        # never sampled: the timeline's SUSPECT->REACHABLE roundtrip gate
+        # and quorum analysis read these
+        _telemetry.emit("detector",
+                        **{"target": int(peer), "from": old, "to": state})
 
     def state_of(self, peer: int) -> str:
         with self._lock:
@@ -367,6 +372,7 @@ class PeerTransport:
                     self._bump("wire_drops")
                     logger.warning("peer %d: dropped frame with hostile "
                                    "header fields: %s", self.peer_id, e)
+                    _telemetry.emit("recv", disposition="hostile")
                     self._ack(conn)  # delivered garbage: never retryable
                     return
                 if (self.gate is not None
@@ -378,6 +384,7 @@ class PeerTransport:
                     logger.info("peer %d: partition gate dropped %s from "
                                 "peer %d", self.peer_id,
                                 header.get("type"), src)
+                    self._recv_event("gate", src, epoch, msg_id, header)
                     self._ack(conn)
                     return
                 if msg_id is not None and not self._dedup_accept(
@@ -386,6 +393,7 @@ class PeerTransport:
                     logger.info("peer %d: dedup dropped duplicate %s "
                                 "(%d, %d)", self.peer_id,
                                 header.get("type"), src, msg_id)
+                    self._recv_event("dedup", src, epoch, msg_id, header)
                     self._ack(conn)
                     return
                 if hold > 0:
@@ -404,20 +412,39 @@ class PeerTransport:
                                         args=(header, trees))
                     t.daemon = True
                     t.start()
+                    # the frame IS accepted (it will enqueue after the
+                    # hold) — emitted before the ack, like every accepted
+                    # disposition, so an acked frame always left a recv
+                    # event behind (the acked_not_lost invariant's ground)
+                    self._recv_event("accepted", src, epoch, msg_id,
+                                     header, held_s=hold)
                     self._ack(conn)
                 elif self._enqueue(header, trees):
+                    self._recv_event("accepted", src, epoch, msg_id,
+                                     header)
                     self._ack(conn)
                 else:
                     self._shed_overflow(header, src, msg_id,
                                         counted=True)
         except CrcError as e:
             self._bump("crc_drops")
+            _telemetry.emit("recv", disposition="crc")
             logger.warning("peer %d: dropped corrupt inbound frame: %s",
                            self.peer_id, e)
         except (WireError, OSError, socket.timeout) as e:
             self._bump("wire_drops")
+            _telemetry.emit("recv", disposition="wire")
             logger.warning("peer %d: dropped malformed/stalled inbound "
                            "frame: %s", self.peer_id, e)
+
+    def _recv_event(self, disposition: str, src: int, epoch: int,
+                    msg_id: Optional[int], header: Dict, **extra) -> None:
+        """One receive-disposition event carrying the (src, msg_epoch,
+        msg_id) transport identity — the receiver half of every
+        cross-process correlation (never sampled)."""
+        _telemetry.emit("recv", disposition=disposition, src=src,
+                        msg_epoch=epoch, msg_id=msg_id,
+                        type=header.get("type"), **extra)
 
     def _ack(self, conn: socket.socket) -> None:
         try:
@@ -437,6 +464,11 @@ class PeerTransport:
             self._bump("inbox_overflow")
         if msg_id is not None:
             self._dedup_unrecord(src, msg_id)
+        # deliberately NO msg_id on the event: the frame was refused
+        # (no ack), so its identity must not satisfy the acked_not_lost
+        # lookup — the retransmit's accepted recv is the one that counts
+        _telemetry.emit("recv", disposition="overflow", src=src,
+                        type=header.get("type"))
         logger.warning("peer %d: inbox full (%d); refused %s (sender "
                        "will retry)", self.peer_id, self.policy.inbox_max,
                        header.get("type"))
@@ -513,10 +545,15 @@ class PeerTransport:
         probe due), or the retry budget expired. It never raises on
         network failure — call sites need no per-call error handling; the
         :meth:`stats` counters and the detector carry the evidence."""
+        t_start = time.time()
         if self.gate is not None and not self.gate.allowed(self.peer_id, to):
+            _telemetry.emit("send", to=to, type=header.get("type"),
+                            ok=False, reason="gate", msg_id=None)
             return False
         if not self.detector.allow(to):
             self.circuit_skips += 1
+            _telemetry.emit("send", to=to, type=header.get("type"),
+                            ok=False, reason="circuit_open", msg_id=None)
             return False
         # a granted probe of a DOWN peer is a SINGLE attempt under a
         # probe-interval-bounded budget: a BLACK-HOLING corpse (SYNs
@@ -558,6 +595,14 @@ class PeerTransport:
             try:
                 self._attempt(to, header, trees, frame, acts, deadline)
                 self.detector.on_success(to)
+                # stamped with the send's START instant (t_wall=t_start):
+                # the causal timeline needs the send to precede the recv
+                # it caused, and emission happens only after the ack
+                _telemetry.emit(
+                    "send", to=to, type=header.get("type"), ok=True,
+                    msg_id=msg_id, msg_epoch=self.epoch,
+                    attempts=attempt + 1, bytes=len(frame),
+                    wall_s=time.time() - t_start, t_wall=t_start)
                 return True
             except TransportError as e:
                 self.detector.on_failure(to)
@@ -573,9 +618,22 @@ class PeerTransport:
                 backoff *= 0.5 + ((self.peer_id * 7919 + to * 104729
                                    + msg_id * 2654435761 + attempt * 97)
                                   % 1024) / 1024.0
+                # per-attempt outcomes are the one high-rate stream —
+                # routed through the sampling knob (telemetry_sample);
+                # the final outcome below is never sampled
+                _telemetry.emit_sampled(
+                    "send.attempt", (self.peer_id, to, msg_id, attempt),
+                    to=to, msg_id=msg_id, attempt=attempt,
+                    outcome=str(e)[:200])
                 if (probe or attempt > pol.send_retries
                         or time.monotonic() + backoff >= deadline):
                     self.send_failures += 1
+                    _telemetry.emit(
+                        "send", to=to, type=header.get("type"), ok=False,
+                        msg_id=msg_id, msg_epoch=self.epoch,
+                        attempts=attempt, reason=str(e)[:200],
+                        probe=probe, wall_s=time.time() - t_start,
+                        t_wall=t_start)
                     # a failed probe of an already-DOWN peer is the
                     # expected steady state, not news — keep the warning
                     # for real delivery failures
@@ -598,23 +656,33 @@ class PeerTransport:
         ``frame`` is the pre-packed clean frame; only the chaos reorder
         path (header mutation) re-packs. Raises :class:`TransportError`
         on any failure."""
+        def _chaos(action: str, **extra) -> None:
+            # per-injection events: high-rate under an armed lane, so
+            # routed through the sampling knob; the lane/draw/target
+            # coordinates make every injection replayable from the stream
+            self.chaos_injected[action] += 1
+            _telemetry.emit_sampled(
+                "chaos", (to, header.get("msg_id"), action),
+                lane="wire", action=action, dst=to,
+                msg_id=header.get("msg_id"), **extra)
+
         if acts is not None and acts["delay_s"] > 0:
-            self.chaos_injected["delay"] += 1
+            _chaos("delay", delay_s=acts["delay_s"])
             time.sleep(min(acts["delay_s"],
                            max(deadline - time.monotonic(), 0.0)))
         if acts is not None and acts["reorder_s"] > 0:
-            self.chaos_injected["reorder"] += 1
+            _chaos("reorder", hold_s=acts["reorder_s"])
             frame = pack_frame(dict(header, chaos_hold_s=acts["reorder_s"]),
                                trees)
         on_wire = frame
         if acts is not None and acts["corrupt"]:
-            self.chaos_injected["corrupt"] += 1
+            _chaos("corrupt")
             on_wire = _flip_payload_bytes(frame, acts["corrupt_pos"])
         if acts is not None and acts["drop"]:
             # the frame vanishes in the network: the receiver never sees
             # it and the sender learns only via the missing ack — modeled
             # without burning a real timeout so chaos runs stay fast
-            self.chaos_injected["drop"] += 1
+            _chaos("drop")
             raise TransportError(
                 f"chaos wire lane dropped msg {header['msg_id']} "
                 f"-> peer {to}")
@@ -625,7 +693,7 @@ class PeerTransport:
             # the main attempt — a stalling receiver must not hold the
             # peer loop past the send's wall budget. The receiver's dedup
             # window is what must absorb the copy.
-            self.chaos_injected["dup"] += 1
+            _chaos("dup")
             try:
                 self._deliver(to, frame, deadline)
             except TransportError:

@@ -2,12 +2,17 @@
 
 Replaces running the 11 reference scripts directly; every SURVEY.md §2.1
 config knob is an override flag.
+
+Subcommands: ``bcfl-tpu trace RUN_DIR`` collates a run's per-process event
+streams into one causally-ordered timeline and runs the invariant checks
+(bcfl_tpu.telemetry, OBSERVABILITY.md) — exit 1 on any violation.
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
+import sys
 
 from bcfl_tpu.compression import KINDS as COMPRESS_KINDS
 from bcfl_tpu.entrypoints.presets import _HF, get_preset, list_presets
@@ -15,6 +20,14 @@ from bcfl_tpu.entrypoints.run import run, run_sweep
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "trace":
+        # the observability subcommand: no jax import, works on any
+        # machine that can read the stream files
+        from bcfl_tpu.telemetry import trace_main
+
+        raise SystemExit(trace_main(argv[1:]))
     ap = argparse.ArgumentParser(prog="bcfl_tpu")
     ap.add_argument("--preset", default="smoke",
                     help=f"one of: {', '.join(list_presets())}")
@@ -278,6 +291,19 @@ def main(argv=None):
                     help="vote weight while on probation (default 0.5)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=None)
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="event-stream directory (bcfl_tpu.telemetry, "
+                         "OBSERVABILITY.md). Default: dist runs stream "
+                         "into their run dir, local runs emit nothing; "
+                         "naming a dir enables streaming on both")
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="disable event streaming everywhere (the "
+                         "overhead-measurement setting)")
+    ap.add_argument("--telemetry-sample", type=float, default=None,
+                    metavar="P",
+                    help="sampling rate in [0,1] for high-rate transport "
+                         "events (per-attempt outcomes, chaos draws); "
+                         "invariant-grade events are never sampled")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. 'cpu' for the virtual "
                          "host mesh). The JAX_PLATFORMS env var is NOT enough "
@@ -501,6 +527,14 @@ def main(argv=None):
     if args.reputation:
         overrides["reputation"] = dataclasses.replace(
             cfg.reputation, enabled=True, **rep_tweaks)
+    if args.no_telemetry and args.telemetry_dir is not None:
+        raise SystemExit("--no-telemetry contradicts --telemetry-dir")
+    if args.no_telemetry:
+        overrides["telemetry_dir"] = "off"
+    elif args.telemetry_dir is not None:
+        overrides["telemetry_dir"] = args.telemetry_dir
+    if args.telemetry_sample is not None:
+        overrides["telemetry_sample"] = args.telemetry_sample
     if args.peers is not None and args.runtime != "dist":
         raise SystemExit("--peers only applies to --runtime dist")
     if args.dist_quorum is not None and args.runtime != "dist":
@@ -586,8 +620,21 @@ def main(argv=None):
             "final_eval": result["reports"].get(0, {}).get("final_eval"),
             "run_dir": run_dir,
         }
+        if result["event_streams"]:
+            # collate the run's event streams right here: the timeline
+            # block + invariant verdicts are the run's observability
+            # surface (re-query any time: `bcfl-tpu trace <run_dir>`).
+            # Collate the paths the harness found — with --telemetry-dir
+            # the streams live outside run_dir
+            from bcfl_tpu.telemetry import collate
+
+            col = collate(result["event_streams"])
+            summary["event_streams"] = result["event_streams"]
+            summary["timeline"] = col["timeline"]
+            summary["invariants"] = col["invariants"]
+            summary["invariants_ok"] = col["ok"]
         print(_json.dumps(summary, indent=2), flush=True)
-        if not result["ok"]:
+        if not result["ok"] or not summary.get("invariants_ok", True):
             raise SystemExit(1)
     elif args.sweep:
         if fused_tamper is not None:

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 import warnings
 from typing import Callable, Dict, Optional
@@ -95,6 +96,7 @@ from bcfl_tpu.metrics import (
 )
 from bcfl_tpu.models import TextClassifier, lora as lora_lib
 from bcfl_tpu.reputation import ReputationTracker
+from bcfl_tpu import telemetry
 from bcfl_tpu.topology import (
     anomaly_filter,
     partitioned_anomaly_filter,
@@ -634,6 +636,9 @@ class FedEngine:
                 rnd, self._client_id(rnd, c),
                 self._entry_digest(kind, fps[c]),
                 self._client_payload_bytes)
+        telemetry.emit("ledger", op="commit", round=int(rnd), n=self.C,
+                       chain_len=len(self.ledger), rewrite=False,
+                       head8=self.ledger.head.hex()[:16])
 
     def _ledger_auth_rows(self, rnd: int, kind: str, fps) -> np.ndarray:
         """0/1 auth mask: do the fingerprint rows match the committed chain
@@ -950,8 +955,30 @@ class FedEngine:
     def run(self, resume: bool = False, on_round=None) -> RunResult:
         """on_round: optional callable(RoundRecord), invoked after each round
         record is finalized (long runs are otherwise silent until the end)."""
-        with trace(self.cfg.profile_dir):
-            return self._run(resume, on_round)
+        # event telemetry (OBSERVABILITY.md): the local engine streams only
+        # when a directory is named (the dist runtime defaults ON instead —
+        # its run dir is the natural home). Installed around the whole run
+        # so StepClock phases, ledger commits, reputation transitions, and
+        # checkpoint events all land in one stream; a SimulatedCrash still
+        # closes it with its status.
+        cfg = self.cfg
+        installed = None
+        if cfg.telemetry_dir and cfg.telemetry_dir != "off":
+            installed = telemetry.install(telemetry.EventWriter(
+                os.path.join(cfg.telemetry_dir, "events_engine.jsonl"),
+                peer=None, run=cfg.name, sample=cfg.telemetry_sample))
+            telemetry.emit("run.start", role="engine", resume=resume,
+                           clients=self.C, rounds=cfg.num_rounds)
+        status = "crashed"
+        try:
+            with trace(self.cfg.profile_dir):
+                out = self._run(resume, on_round)
+            status = "ok"
+            return out
+        finally:
+            if installed is not None:
+                telemetry.emit("run.end", status=status)
+                telemetry.uninstall()
 
     def _run(self, resume: bool = False, on_round=None) -> RunResult:
         cfg = self.cfg
@@ -1125,6 +1152,9 @@ class FedEngine:
                 self._maybe_eval(last_rnd, recs[-1], trainable, stacked, clock)
                 metrics.rounds.extend(recs)
                 self._maybe_checkpoint(last_rnd, trainable, stacked)
+                for r in recs:
+                    telemetry.emit("round", round=r.round, wall_s=r.wall_s,
+                                   fused=True, degraded=r.degraded)
                 if on_round is not None:
                     for r in recs:
                         on_round(r)
@@ -1258,6 +1288,9 @@ class FedEngine:
             self._maybe_eval(rnd, rec, trainable, stacked, clock)
             metrics.rounds.append(rec)
             self._maybe_checkpoint(rnd, trainable, stacked)
+            telemetry.emit("round", round=rnd, wall_s=rec.wall_s,
+                           degraded=rec.degraded, healed=rec.healed,
+                           partitioned=rec.partition is not None)
             if on_round is not None:
                 on_round(rec)
             rnd += 1

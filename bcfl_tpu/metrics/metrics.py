@@ -40,20 +40,34 @@ def model_size_gb(tree) -> float:
 
 
 class ResourceMonitor:
-    """before/after psutil capture, with before actually before."""
+    """before/after psutil capture, with before actually before.
+
+    psutil interval semantics (the part the reference gets wrong twice):
+    ``Process.cpu_percent(None)`` is a *windowed* measurement — each call
+    reports the average CPU utilization since the PREVIOUS call, and the
+    very first call has no previous window, so it always returns a
+    meaningless ``0.0`` and merely arms the baseline. ``__init__``
+    therefore makes a priming call whose result is *discarded* (the old
+    code stored that 0.0 as ``cpu_before``, a number that could never mean
+    anything); ``snapshot()``'s reading then covers exactly the
+    init -> snapshot window. Calling :meth:`snapshot` more than once is
+    supported, but each later reading covers only the window since the
+    previous snapshot — not the whole run."""
 
     def __init__(self):
         import psutil
 
         self._proc = psutil.Process()
         self._psutil = psutil
-        self.cpu_before = self._proc.cpu_percent()
+        self._proc.cpu_percent(None)  # prime: first call is always 0.0
         self.rss_before = self._proc.memory_info().rss
         self.t_before = time.time()
 
     def snapshot(self) -> Dict[str, float]:
         return {
-            "cpu_percent": self._proc.cpu_percent(),
+            # average CPU% over the window since __init__ (or the previous
+            # snapshot) — see the interval semantics above
+            "cpu_percent": self._proc.cpu_percent(None),
             "memory_gb": (self._proc.memory_info().rss - self.rss_before) / 1e9,
             "latency_min": (time.time() - self.t_before) / 60.0,
         }

@@ -18,9 +18,16 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
+from bcfl_tpu.telemetry import events as _telemetry
+
 
 class StepClock:
-    """Named phase timers: ``with clock.phase("train"): ...`` per round."""
+    """Named phase timers: ``with clock.phase("train"): ...`` per round.
+
+    Every completed phase also feeds the run's event stream as a typed
+    ``phase`` span (bcfl_tpu.telemetry, OBSERVABILITY.md) — a no-op unless
+    the run installed an event writer, so the pre-telemetry cost model is
+    unchanged."""
 
     def __init__(self):
         self._times: Dict[str, List[float]] = defaultdict(list)
@@ -31,10 +38,13 @@ class StepClock:
         try:
             yield
         finally:
-            self._times[name].append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._times[name].append(dt)
+            _telemetry.emit("phase", name=name, wall_s=dt)
 
     def record(self, name: str, seconds: float):
         self._times[name].append(seconds)
+        _telemetry.emit("phase", name=name, wall_s=seconds)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         import numpy as np

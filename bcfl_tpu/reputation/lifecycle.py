@@ -47,6 +47,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from bcfl_tpu.telemetry import events as _telemetry
+
 # lifecycle states (ints so the state vector checkpoints as a plain array)
 HEALTHY = 0
 SUSPECT = 1
@@ -177,6 +179,16 @@ class ReputationTracker:
         fault = np.clip(np.asarray(fault, np.float64), 0.0, 1.0)
         act = (np.ones((self.n,), bool) if active is None
                else np.asarray(active, bool))
+        # telemetry (OBSERVABILITY.md): evidence events BEFORE the state
+        # machine advances, so the quarantine_evidence invariant can see
+        # cause precede effect in the same stream. Quarantined peers were
+        # excluded this round — their scores are not evidence.
+        if _telemetry.get_writer() is not None:
+            for c in np.nonzero(act & (fault > 0.0)
+                                & (self.state != QUARANTINED))[0]:
+                _telemetry.emit("rep.evidence", client=int(c),
+                                fault=float(fault[c]))
+        state_before = self.state.copy()
         for c in range(self.n):
             if self.state[c] == QUARANTINED:
                 # excluded this round: no evidence, the sentence just ticks
@@ -209,6 +221,13 @@ class ReputationTracker:
                 self.state[c] = SUSPECT
             else:
                 self.state[c] = HEALTHY
+        if _telemetry.get_writer() is not None:
+            for c in np.nonzero(self.state != state_before)[0]:
+                _telemetry.emit(
+                    "rep.transition", client=int(c),
+                    **{"from": STATE_NAMES[int(state_before[c])],
+                       "to": STATE_NAMES[int(self.state[c])],
+                       "trust": float(self.trust[c])})
 
     def _quarantine(self, c: int) -> None:
         self.state[c] = QUARANTINED

@@ -1,0 +1,43 @@
+"""Run-wide event tracing: crash-safe per-process telemetry streams, a
+causal cross-peer collator, and invariant checks as queries
+(OBSERVABILITY.md).
+
+- :mod:`bcfl_tpu.telemetry.events` — the append-only buffered JSONL
+  :class:`EventWriter` plus the process-global :func:`emit` seam every
+  subsystem (transport, dist runtime, engine, ledger commits, reputation,
+  checkpoints) reports through; a no-op until a writer is installed.
+- :mod:`bcfl_tpu.telemetry.collate` — torn-tail-tolerant stream reader,
+  happens-before causal merge across processes, timeline rollups
+  (message latency, staleness, merge lineage, per-phase/per-peer), and the
+  ``bcfl-tpu trace`` CLI.
+- :mod:`bcfl_tpu.telemetry.invariants` — the declared invariant catalogue
+  (no double-merge, acked-never-lost, no cross-partition merge,
+  quarantine-with-evidence, monotone ledger heads) run as queries over the
+  merged stream.
+"""
+
+from bcfl_tpu.telemetry.collate import (  # noqa: F401
+    causal_order,
+    collate,
+    collate_run,
+    find_streams,
+    resolve_stream_dir,
+    read_stream,
+    summarize,
+    trace_main,
+)
+from bcfl_tpu.telemetry.events import (  # noqa: F401
+    EVENT_TYPES,
+    SCHEMA_VERSION,
+    EventWriter,
+    emit,
+    emit_sampled,
+    flush,
+    get_writer,
+    install,
+    uninstall,
+)
+from bcfl_tpu.telemetry.invariants import (  # noqa: F401
+    INVARIANTS,
+    run_invariants,
+)

@@ -1,0 +1,240 @@
+"""Invariant checks as queries over the merged event stream
+(OBSERVABILITY.md §4).
+
+Each check is a pure function ``(ordered_events) -> [violation, ...]`` over
+the causally-ordered timeline the collator produces; a violation is a small
+dict naming the rule, the offending identity, and the evidence. The checks
+replace the bespoke per-script gate logic that used to live in
+``scripts/dist_chaos.py`` / ``scripts/dist_async.py`` — one shared,
+*testable* implementation (tests/test_telemetry.py corrupts fixture streams
+and asserts each rule fires) that every proof script and CI leg queries.
+
+The catalogue (RUNTIME.md §4 "Delivery contract" names the evidence each
+rule consumes):
+
+- **no_double_merge** — at-least-once delivery is made safe by the
+  receiver's dedup window; therefore no ``(leader, from, msg_epoch,
+  msg_id)`` update identity may ever be merged twice (and every merged
+  arrival must carry an identity at all).
+- **acked_not_lost** — an acked frame was enqueued (or deliberately
+  discarded by gate/dedup policy) on the receiver; either way the receiver
+  emitted a ``recv`` event for it. A send recorded ``ok`` whose identity
+  never appears in the receiver's stream is a lost acked frame. Only
+  enforced against receivers whose stream closed cleanly (``run.end``):
+  a SIGKILLed receiver's unflushed buffer tail proves nothing.
+- **no_cross_partition_merge** — the partition gate drops frames whose
+  origin is outside the receiver's component; a merge that composed an
+  update from a peer outside the leader's recorded component crossed a
+  partition that was supposed to exist.
+- **quarantine_evidence** — the reputation lifecycle quarantines only on
+  observed evidence; a ``rep.transition`` to ``quarantined`` with no prior
+  ``rep.evidence`` for that client in the same stream is a state machine
+  acting on nothing.
+- **monotone_heads** — a peer's ledger chain only ever grows, except at a
+  declared rewrite (fork-merge adoption / full resync), which the emitting
+  site flags ``rewrite: true``. A length decrease on a non-rewrite event
+  is silent history loss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+# grace window for acked_not_lost: a frame acked in the instants between a
+# receiver's terminal flush and its process exit may have its recv event
+# only in the (lost) buffer tail — sends that close to the receiver's
+# run.end are not judged
+ACK_GRACE_S = 1.0
+
+
+def _peer_of(e: Dict):
+    return e.get("peer")
+
+
+def no_double_merge(events: List[Dict]) -> List[Dict]:
+    # scoped by the LEADER's process incarnation (pid): append-mode
+    # streams can hold several runs, each restarting its epoch files and
+    # msg_id counters from scratch — identical identities across
+    # incarnations are different messages, not dedup failures. Within one
+    # leader process the dedup window is exactly what this rule checks.
+    seen = {}
+    out = []
+    for e in events:
+        if e.get("ev") != "merge":
+            continue
+        leader = (_peer_of(e), e.get("pid"))
+        for a in e.get("arrivals") or []:
+            if a.get("msg_id") is None:
+                out.append({
+                    "rule": "no_double_merge",
+                    "problem": "merged arrival without (msg_epoch, msg_id) "
+                               "identity",
+                    "leader": leader[0], "leader_pid": leader[1],
+                    "version": e.get("version"),
+                    "arrival": a,
+                })
+                continue
+            key = (leader, a.get("peer"), a.get("msg_epoch"),
+                   a.get("msg_id"))
+            if key in seen:
+                out.append({
+                    "rule": "no_double_merge",
+                    "problem": "update identity merged twice",
+                    "leader": leader[0], "leader_pid": leader[1],
+                    "key": list(key[1:]),
+                    "first_version": seen[key],
+                    "second_version": e.get("version"),
+                })
+            else:
+                seen[key] = e.get("version")
+    return out
+
+
+def acked_not_lost(events: List[Dict]) -> List[Dict]:
+    # receivers' seen identities + clean-close instants. A receiver is
+    # only judged when its stream shows exactly ONE process incarnation
+    # (one pid) that closed cleanly (run.end): a killed-and-restarted
+    # peer's stream carries a second incarnation's run.end, while the
+    # first incarnation's final buffer tail — and the recv events in it —
+    # was legitimately lost to the SIGKILL.
+    recv_seen = {}   # peer -> set of (src, msg_epoch, msg_id)
+    closed_at = {}   # peer -> run.end t_wall
+    pids = {}        # peer -> set of pids seen in the stream
+    for e in events:
+        ev = e.get("ev")
+        p = _peer_of(e)
+        if e.get("pid") is not None:
+            pids.setdefault(p, set()).add(e.get("pid"))
+        if ev == "recv" and e.get("msg_id") is not None:
+            recv_seen.setdefault(p, set()).add(
+                (e.get("src"), e.get("msg_epoch"), e.get("msg_id")))
+        elif ev == "run.end":
+            closed_at[p] = e.get("t_wall", 0.0)
+    out = []
+    for e in events:
+        if e.get("ev") != "send" or not e.get("ok"):
+            continue
+        if e.get("msg_id") is None:
+            continue
+        dst = e.get("to")
+        end = closed_at.get(dst)
+        # graced against the send's END instant (t_wall is the START;
+        # wall_s the duration): a chaos-retried send can be acked many
+        # seconds after it began, and it is the ACK that must clear the
+        # receiver's final flush — not the first attempt
+        sent_done = e.get("t_wall", 0.0) + (e.get("wall_s") or 0.0)
+        if end is None or sent_done > end - ACK_GRACE_S:
+            continue  # receiver crashed / send too close to its close
+        if len(pids.get(dst, ())) > 1:
+            continue  # receiver restarted mid-run: kill-window not provable
+        key = (_peer_of(e), e.get("msg_epoch"), e.get("msg_id"))
+        if key not in recv_seen.get(dst, ()):
+            out.append({
+                "rule": "acked_not_lost",
+                "problem": "acked send never appeared in the receiver's "
+                           "stream",
+                "src": _peer_of(e), "dst": dst,
+                "msg_epoch": e.get("msg_epoch"), "msg_id": e.get("msg_id"),
+                "type": e.get("type"),
+            })
+    return out
+
+
+def no_cross_partition_merge(events: List[Dict]) -> List[Dict]:
+    out = []
+    for e in events:
+        if e.get("ev") != "merge":
+            continue
+        comp = e.get("component")
+        if not comp:
+            continue
+        comp_set = set(comp)
+        for a in e.get("arrivals") or []:
+            if a.get("peer") is not None and a["peer"] not in comp_set:
+                out.append({
+                    "rule": "no_cross_partition_merge",
+                    "problem": "merged an update from outside the leader's "
+                               "component",
+                    "leader": _peer_of(e), "version": e.get("version"),
+                    "component": comp, "from_peer": a["peer"],
+                })
+    return out
+
+
+def quarantine_evidence(events: List[Dict]) -> List[Dict]:
+    evidenced = set()  # (stream peer, client) with prior evidence
+    out = []
+    for e in events:
+        ev = e.get("ev")
+        if ev == "rep.evidence":
+            evidenced.add((_peer_of(e), e.get("client")))
+        elif ev == "rep.transition" and e.get("to") == "quarantined":
+            key = (_peer_of(e), e.get("client"))
+            if key not in evidenced:
+                out.append({
+                    "rule": "quarantine_evidence",
+                    "problem": "quarantined with no prior evidence event",
+                    "peer": _peer_of(e), "client": e.get("client"),
+                    "trust": e.get("trust"),
+                })
+    return out
+
+
+def monotone_heads(events: List[Dict]) -> List[Dict]:
+    # keyed by (peer, pid): streams are opened in append mode, so one
+    # file can hold several process incarnations (a re-run into the same
+    # telemetry_dir, a within-run restart) — each incarnation starts its
+    # own chain-length baseline rather than inheriting its predecessor's
+    # final length as a floor
+    last: Dict = {}  # (stream peer, pid) -> last seen chain_len
+    out = []
+    for e in events:
+        if "chain_len" not in e:
+            continue
+        n = e.get("chain_len")
+        if n is None:
+            continue
+        p = (_peer_of(e), e.get("pid"))
+        prev = last.get(p)
+        if (prev is not None and n < prev and not e.get("rewrite")):
+            out.append({
+                "rule": "monotone_heads",
+                "problem": "ledger chain shrank outside a declared rewrite",
+                "peer": p[0], "pid": p[1], "event": e.get("ev"),
+                "op": e.get("op"), "prev_len": prev, "new_len": n,
+            })
+        last[p] = n
+    return out
+
+
+# name -> (check fn, one-line description); the collator and the trace CLI
+# walk this registry — adding a rule here adds it to every consumer
+INVARIANTS = {
+    "no_double_merge": (
+        no_double_merge,
+        "no (leader, from, msg_epoch, msg_id) update merged twice"),
+    "acked_not_lost": (
+        acked_not_lost,
+        "every acked send appears in the (cleanly-closed) receiver's "
+        "stream"),
+    "no_cross_partition_merge": (
+        no_cross_partition_merge,
+        "no merge composes an update from outside the leader's component"),
+    "quarantine_evidence": (
+        quarantine_evidence,
+        "quarantine transitions only follow observed evidence"),
+    "monotone_heads": (
+        monotone_heads,
+        "per-peer ledger length is monotone outside declared rewrites"),
+}
+
+
+def run_invariants(events: List[Dict],
+                   names=None) -> Dict[str, List[Dict]]:
+    """Run the named invariant checks (default: all) over a causally
+    ordered event list; returns {name: [violations]} for every check run
+    (empty lists included, so 'checked and clean' is distinguishable from
+    'not checked')."""
+    picked = INVARIANTS if names is None else {
+        n: INVARIANTS[n] for n in names}
+    return {name: fn(events) for name, (fn, _doc) in picked.items()}

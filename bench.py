@@ -61,6 +61,13 @@ MODE = os.environ.get("BCFL_BENCH_MODE", "server")  # server | serverless
 # backend-init watchdog is armed; tests/test_compression.py pins the copies
 COMPRESS_KINDS = ("none", "int8", "topk", "int8+topk")
 COMPRESS = os.environ.get("BCFL_BENCH_COMPRESS", "none")
+# opt-in event telemetry (OBSERVABILITY.md): a directory here makes the
+# bench stream run/phase events (bcfl_tpu.telemetry) into
+# events_bench.jsonl there, and every JSON line stamps `event_stream`
+# with the stream path — or "disabled", so a line's observability story
+# is explicit either way. Off hot path: nothing is emitted inside the
+# timed loop.
+TELEMETRY_DIR = os.environ.get("BCFL_BENCH_TELEMETRY_DIR")
 STAGE_TIMEOUT_S = 1200.0  # per STAGE, reset on every stage transition
 # backend init gets a SHORT deadline: healthy init is 20-40s, a wedged
 # tunnel hangs forever, and the error JSON must outrun the DRIVER's own
@@ -118,6 +125,13 @@ def _compress_cfg():
     return CompressionConfig(kind=COMPRESS)
 
 
+def _event_stream() -> str:
+    """The JSON-line `event_stream` stamp: the telemetry stream path when
+    BCFL_BENCH_TELEMETRY_DIR is set, else the explicit "disabled"."""
+    return (os.path.join(TELEMETRY_DIR, "events_bench.jsonl")
+            if TELEMETRY_DIR else "disabled")
+
+
 def _error_json(stage: str, err: str):
     out = {
         "metric": _metric_name(),
@@ -125,6 +139,7 @@ def _error_json(stage: str, err: str):
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
         "backend_init_ok": _BACKEND_INIT_OK,
+        "event_stream": _event_stream(),
         "error": f"{stage}: {err[:400]}",
     }
     # a wedged-tunnel window at the recording moment must not erase the
@@ -255,6 +270,14 @@ def main():
             raise RuntimeError(f"preflight readback mismatch: {probe!r}")
         _BACKEND_INIT_OK = True
 
+        if TELEMETRY_DIR:
+            from bcfl_tpu import telemetry
+
+            telemetry.install(telemetry.EventWriter(
+                _event_stream(), peer=None, run="bench"))
+            telemetry.emit("run.start", role="bench", mode=MODE,
+                           rounds=ROUNDS, steps=STEPS, iters=ITERS)
+
         n_dev = len(devices)
         kind = devices[0].device_kind
         peak = PEAK_FLOPS.get(kind)
@@ -361,6 +384,15 @@ def main():
         if trace_dir:
             jax.profiler.stop_trace()
 
+        if TELEMETRY_DIR:
+            from bcfl_tpu import telemetry
+
+            # one span event for the whole timed block — emitted AFTER the
+            # completion fence, so nothing rides inside the measurement
+            telemetry.emit("phase", name="bench_measure", wall_s=dt,
+                           iters=ITERS)
+            telemetry.emit("run.end", status="ok")
+            telemetry.uninstall()
         samples = ITERS * ROUNDS * num_clients * STEPS * BATCH
         sps_chip = samples / dt / n_dev
         flops = 6.0 * n_params * samples * SEQ
@@ -370,6 +402,7 @@ def main():
             "unit": "samples/sec/chip",
             "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC, 2),
             "backend_init_ok": _BACKEND_INIT_OK,
+            "event_stream": _event_stream(),
             "device": kind,
             "params_m": round(n_params / 1e6, 1),
             "steps_per_dispatch": ROUNDS * STEPS,

@@ -156,4 +156,36 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/dist_chaos.py --peers 2 --rounds 6 --legs wire \
     --wire-corrupt 0.0 --deadline 400 --idle-timeout 90 \
     --out /tmp/bcfl_chaos_dist_chaos.json
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Collator leg (OBSERVABILITY.md): re-run `bcfl-tpu trace` standalone over
+# the wire-chaos run's per-peer event streams — merges them into one
+# causally-ordered timeline and FAILS on any delivery-contract invariant
+# violation (no double-merge, nothing acked lost, no cross-partition
+# merge, monotone ledger heads). dist_chaos already gated on the same
+# checks in-process; this leg proves the on-disk streams alone carry the
+# full evidence (the post-hoc debugging workflow).
+echo
+echo "collator leg: bcfl-tpu trace over the wire-chaos event streams"
+WIRE_RUN_DIR=$(python -c "import json; print(json.load(open(
+    '/tmp/bcfl_chaos_dist_chaos.json'))['legs']['wire']['run_dir'])")
+timeout -k 10 120 python -m bcfl_tpu.entrypoints trace "$WIRE_RUN_DIR" \
+    > /tmp/bcfl_chaos_trace.json
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "collator leg FAILED (rc=$rc); see /tmp/bcfl_chaos_trace.json" >&2
+  exit "$rc"
+fi
+python -c "
+import json
+d = json.load(open('/tmp/bcfl_chaos_trace.json'))
+t = d['timeline']
+print('collator: %d events, %d merges (%d arrivals, %d unique ids), '
+      'latency p95 %.3fs, invariants %s' % (
+    t['events'], t['merges']['count'], t['merges']['arrivals'],
+    t['merges']['unique_update_ids'],
+    (t['message_latency_s'] or {}).get('p95', float('nan')),
+    'CLEAN' if d['ok'] else 'VIOLATED'))
+"
 exit $?

@@ -80,21 +80,27 @@ def build_cfg(args):
 
 
 def analyze(result, cfg, partitioned: bool, killed) -> dict:
-    """Reduce the per-peer reports to the proof record + pass/fail gates."""
+    """Reduce the per-peer reports to the proof record + pass/fail gates.
+
+    The message-latency / staleness / merge-lineage numbers come from the
+    COLLATOR over the run's per-peer event streams (bcfl_tpu.telemetry,
+    OBSERVABILITY.md) — the same causally-ordered timeline `bcfl-tpu
+    trace` produces — and the delivery-contract invariants gate the run,
+    replacing this script's former hand-rolled counter math."""
+    from bcfl_tpu.telemetry import collate
+
     reports = result["reports"]
     peers = cfg.dist.peers
     gates = {}
-    staleness = []
-    for rep in reports.values():
-        staleness.extend(rep.get("staleness_values") or [])
-    latencies = [x for rep in reports.values()
-                 for x in (rep.get("arrival_latency_s") or [])]
+    # the stream paths the harness found (they follow a path-valued
+    # telemetry_dir), not blindly the run dir
+    col = collate(result["event_streams"])
+    timeline = col["timeline"]
     gates["all_peers_completed"] = (
         result["ok"] and len(reports) == peers)
-    gates["staleness_measured_nonzero"] = any(s > 0 for s in staleness)
-    hist = {}
-    for s in staleness:
-        hist[str(s)] = hist.get(str(s), 0) + 1
+    gates["staleness_measured_nonzero"] = any(
+        int(k) > 0 and v > 0 for k, v in timeline["staleness"].items())
+    gates["zero_invariant_violations"] = col["ok"]
 
     fork_rec = None
     reconcile = None
@@ -151,13 +157,20 @@ def analyze(result, cfg, partitioned: bool, killed) -> dict:
         "final_versions": {p: r.get("final_version")
                           for p, r in reports.items()},
         "transport": transport,
-        "staleness_distribution": hist,
-        "staleness_samples": len(staleness),
-        "arrival_latency_s": {
-            "n": len(latencies),
-            "mean": (sum(latencies) / len(latencies)) if latencies else None,
-            "max": max(latencies) if latencies else None,
+        # collator-produced observability block (bcfl_tpu.telemetry):
+        # message-latency p50/p95, staleness histogram, merge-lineage
+        # counts, per-peer rollups — plus the invariant verdicts
+        "timeline": {
+            "message_latency_s": timeline["message_latency_s"],
+            "staleness": timeline["staleness"],
+            "merges": timeline["merges"],
+            "merge_weight": timeline["merge_weight"],
+            "per_peer": timeline["per_peer"],
         },
+        "invariants": col["invariants"],
+        "invariant_violations": col["violations"],
+        "torn_tails": col["torn_tails"],
+        "event_streams": result.get("event_streams"),
         "fork": fork_rec,
         "kill": result.get("kill"),
         "final_eval": reports.get(0, {}).get("final_eval"),
@@ -227,7 +240,7 @@ def main(argv=None) -> int:
     with open(args.out, "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps({k: v for k, v in record.items()
-                      if k in ("gates", "staleness_distribution",
+                      if k in ("gates", "invariants", "timeline",
                                "final_versions", "wall_s", "ok")},
                      indent=2), flush=True)
     if not record["ok"]:

@@ -1,0 +1,23 @@
+"""Collate a run's per-process telemetry streams into one causally-ordered
+timeline and run the invariant checks (OBSERVABILITY.md).
+
+Thin ``scripts/`` entry over ``bcfl-tpu trace`` / ``python -m
+bcfl_tpu.entrypoints trace`` — same flags, same exit semantics (1 on any
+invariant violation):
+
+    python scripts/trace_timeline.py /tmp/bcfl_dist_cli_1234
+    python scripts/trace_timeline.py RUN_DIR --dump timeline.jsonl
+    python scripts/trace_timeline.py --list-invariants dummy
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bcfl_tpu.telemetry import trace_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(trace_main())

@@ -149,3 +149,19 @@ def test_dist_loopback_two_peers(tmp_path):
     assert all(reports[p]["chain_ok"] for p in (0, 1))
     assert reports[0]["chain_head"] == reports[1]["chain_head"]
     assert reports[0]["final_eval"] is not None
+    # telemetry (OBSERVABILITY.md): both peers streamed events, and the
+    # collator merges them into a causal timeline with ZERO invariant
+    # violations — the standing CI observation of the delivery contract
+    from bcfl_tpu.telemetry import collate_run
+
+    assert len(result["event_streams"]) == 2, result["event_streams"]
+    col = collate_run(result["run_dir"])
+    assert col["ok"], col["violations"]
+    t = col["timeline"]
+    assert t["merges"]["count"] >= cfg.num_rounds
+    assert t["merges"]["arrivals"] == t["merges"]["unique_update_ids"]
+    assert t["message_latency_s"]["n"] > 0
+    assert any(int(k) > 0 for k in t["staleness"])
+    # both peers closed their streams cleanly (run.end) and flushed
+    ends = [e for e in col["ordered"] if e["ev"] == "run.end"]
+    assert {e["peer"] for e in ends} == {0, 1}

@@ -1,0 +1,465 @@
+"""Telemetry layer (bcfl_tpu.telemetry, OBSERVABILITY.md) — tier-1.
+
+Three contracts, each pinned here because the dist chaos proofs GATE on
+them:
+
+1. **Event schema round-trip + crash tolerance** — typed events survive
+   the writer -> stream -> reader path bit-intact; a torn final line (the
+   SIGKILL signature) is tolerated and counted, never raised.
+2. **Causal collation** — the merged timeline orders a send before the
+   recv it caused even when the receiver's wall clock is skewed BEHIND
+   the sender's (the cross-host case wall-sorting gets wrong), while
+   preserving each stream's own seq order.
+3. **Invariants fire** — every declared invariant detects its seeded
+   corruption (double-merge, lost acked frame, cross-partition merge,
+   quarantine without evidence, shrinking chain) and stays silent on the
+   clean twin. A check that cannot fail is not a check.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bcfl_tpu import telemetry as T
+
+pytestmark = pytest.mark.telemetry
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _ev(ev, peer, seq, t, pid=None, **fields):
+    """A hand-built stream event (what EventWriter would have written)."""
+    rec = {"v": 1, "ev": ev, "run": "fx", "peer": peer,
+           "pid": pid if pid is not None else 1000 + (hash(peer) % 7),
+           "seq": seq, "t_wall": t, "t_mono": t}
+    rec.update(fields)
+    return rec
+
+
+def _send(peer, seq, t, to, msg_id, epoch=1, ok=True, mtype="update"):
+    return _ev("send", peer, seq, t, to=to, type=mtype, ok=ok,
+               msg_id=msg_id, msg_epoch=epoch, attempts=1, wall_s=0.01)
+
+
+def _recv(peer, seq, t, src, msg_id, epoch=1, disposition="accepted"):
+    return _ev("recv", peer, seq, t, src=src, msg_epoch=epoch,
+               msg_id=msg_id, disposition=disposition, type="update")
+
+
+def _merge(peer, seq, t, version, arrivals, component=(0, 1, 2),
+           **kw):
+    return _ev("merge", peer, seq, t, version=version, leader=peer,
+               arrivals=arrivals, rejected=[], solo=False, degraded=False,
+               component=list(component), wall_s=0.01, **kw)
+
+
+def _end(peer, seq, t):
+    return _ev("run.end", peer, seq, t, status="ok")
+
+
+def _arrival(peer, msg_id, epoch=1, staleness=0, weight=1.0):
+    return {"peer": peer, "msg_id": msg_id, "msg_epoch": epoch,
+            "staleness": staleness, "latency_s": 0.01, "weight": weight}
+
+
+# ------------------------------------------------- writer / reader contract
+
+
+def test_event_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "events_peer0.jsonl")
+    w = T.EventWriter(path, peer=0, run="rt", flush_every=2)
+    w.emit("run.start", role="peer", peers=3)
+    w.emit("send", to=1, type="update", ok=True, msg_id=7, msg_epoch=2,
+           attempts=1, bytes=123, wall_s=0.25, t_wall=1234.5)
+    w.emit("merge", version=1, leader=0,
+           arrivals=[_arrival(1, 0, staleness=2, weight=3.5)],
+           rejected=[], solo=False, degraded=False, component=[0, 1],
+           wall_s=0.1, chain_len=4, head8="ab", rewrite=False)
+    w.emit("run.end", status="ok")
+    w.close()
+
+    events, meta = T.read_stream(path)
+    assert not meta["torn_tail"] and meta["corrupt_lines"] == 0
+    assert [e["seq"] for e in events] == [0, 1, 2, 3]
+    assert [e["ev"] for e in events] == ["run.start", "send", "merge",
+                                        "run.end"]
+    send = events[1]
+    # stamps: hybrid time + identity fields survive exactly, and the
+    # explicit t_wall override (the send START instant) is honored
+    assert send["t_wall"] == 1234.5 and "t_mono" in send
+    assert (send["peer"], send["to"], send["msg_epoch"], send["msg_id"]) \
+        == (0, 1, 2, 7)
+    m = events[2]
+    assert m["arrivals"][0]["staleness"] == 2
+    assert m["arrivals"][0]["weight"] == 3.5
+    assert m["chain_len"] == 4 and m["rewrite"] is False
+
+
+def test_writer_drops_bad_events_never_raises(tmp_path):
+    w = T.EventWriter(str(tmp_path / "e.jsonl"), peer=0)
+    w.emit("not.a.type", x=1)            # unknown type
+    w.emit("send", to=1)                 # missing required fields
+    w.emit("phase", name="x", wall_s=object())  # unserializable -> str()
+    w.close()
+    events, _ = T.read_stream(str(tmp_path / "e.jsonl"))
+    assert w.dropped == 2
+    assert [e["ev"] for e in events] == ["phase"]
+
+
+def test_numpy_values_serialize(tmp_path):
+    w = T.EventWriter(str(tmp_path / "e.jsonl"), peer=0)
+    w.emit("round", round=np.int64(3), wall_s=np.float32(0.5),
+           extra=np.arange(3))
+    w.close()
+    (e,), _ = T.read_stream(str(tmp_path / "e.jsonl"))
+    assert e["round"] == 3 and e["extra"] == [0, 1, 2]
+
+
+def test_torn_tail_and_corrupt_lines_tolerated(tmp_path):
+    path = str(tmp_path / "events_peer1.jsonl")
+    w = T.EventWriter(path, peer=1)
+    for r in range(5):
+        w.emit("round", round=r, wall_s=0.1)
+    w.close()
+    raw = open(path, "rb").read().splitlines(keepends=True)
+    # corrupt a MIDDLE line (disk damage) and tear the FINAL one (SIGKILL
+    # mid-write): the reader must yield every other event and count both
+    raw[2] = b'{"v": 1, "ev": "round", GARBAGE\n'
+    raw.append(b'{"v":1,"ev":"round","pee')  # no newline: torn
+    with open(path, "wb") as f:
+        f.writelines(raw)
+    events, meta = T.read_stream(path)
+    assert meta["torn_tail"] is True
+    assert meta["corrupt_lines"] == 1
+    assert [e["round"] for e in events] == [0, 1, 3, 4]
+    # and the collator consumes the same stream without raising
+    col = T.collate([path])
+    assert col["torn_tails"] == 1
+    assert col["timeline"]["per_peer"]["1"]["rounds"] == 4
+
+
+def test_append_reopen_terminates_torn_tail(tmp_path):
+    # a restarted incarnation reopens the stream in append mode: the
+    # predecessor's torn final line must be newline-terminated first, or
+    # the restart's first event would be glued onto it and lost
+    path = str(tmp_path / "events_peer1.jsonl")
+    w = T.EventWriter(path, peer=1)
+    w.emit("round", round=0, wall_s=0.1)
+    w.close()
+    with open(path, "ab") as f:
+        f.write(b'{"v":1,"ev":"round","pee')  # SIGKILL mid-write
+    w2 = T.EventWriter(path, peer=1)
+    w2.emit("run.start", role="peer")
+    w2.close()
+    events, meta = T.read_stream(path)
+    assert [e["ev"] for e in events] == ["round", "run.start"]
+    # the terminated torn line is now mid-file: counted, not fatal
+    assert meta["corrupt_lines"] == 1 and meta["torn_tail"] is False
+
+
+def test_sampling_deterministic_and_exact_at_extremes(tmp_path):
+    w1 = T.EventWriter(str(tmp_path / "a.jsonl"), peer=0, sample=0.5)
+    w2 = T.EventWriter(str(tmp_path / "b.jsonl"), peer=0, sample=0.5)
+    keys = [(0, 1, i, 0) for i in range(200)]
+    picked1 = [k for k in keys if w1.sampled(k)]
+    picked2 = [k for k in keys if w2.sampled(k)]
+    assert picked1 == picked2          # deterministic across writers
+    assert 0 < len(picked1) < len(keys)  # actually samples
+    w1.sample = 0.0
+    assert not any(w1.sampled(k) for k in keys)
+    w1.sample = 1.0
+    assert all(w1.sampled(k) for k in keys)
+    w1.close()
+    w2.close()
+
+
+# -------------------------------------------------------- causal collation
+
+
+def test_causal_order_repairs_skewed_clocks():
+    """Receiver clock 40s BEHIND the sender: wall sort would put the recv
+    (and the merge it fed) before the send. The happens-before edges must
+    repair that while keeping each stream's own seq order."""
+    send = _send("A", seq=1, t=100.0, to="B", msg_id=9)
+    pre = _ev("run.start", "A", 0, 99.0, role="peer")
+    # B's stream, 40s skewed: recv at t=60, merge at t=61
+    recv = _recv("B", seq=0, t=60.0, src="A", msg_id=9)
+    merge = _merge("B", seq=1, t=61.0, version=1,
+                   arrivals=[_arrival("A", 9)])
+    ordered = T.causal_order([merge, recv, send, pre])
+    pos = {(e["ev"], e.get("peer")): i for i, e in enumerate(ordered)}
+    assert pos[("send", "A")] < pos[("recv", "B")]
+    assert pos[("recv", "B")] < pos[("merge", "B")]
+    assert pos[("run.start", "A")] < pos[("send", "A")]
+
+
+def test_causal_order_preserves_per_stream_seq():
+    evs = [_ev("round", "P", seq=s, t=100.0 - s, round=s, wall_s=0.1)
+           for s in range(6)]  # wall times REVERSED vs seq
+    ordered = T.causal_order(list(reversed(evs)))
+    assert [e["seq"] for e in ordered] == list(range(6))
+
+
+def test_summarize_latency_staleness_lineage():
+    events = [
+        _send("A", 0, 10.0, to="B", msg_id=0),
+        _send("A", 1, 11.0, to="B", msg_id=1),
+        _recv("B", 0, 10.5, src="A", msg_id=0),
+        _recv("B", 1, 12.0, src="A", msg_id=1),
+        _recv("B", 2, 12.1, src="A", msg_id=1, disposition="dedup"),
+        _merge("B", 3, 13.0, version=1,
+               arrivals=[_arrival("A", 0, staleness=0, weight=2.0),
+                         _arrival("A", 1, staleness=3, weight=1.0)]),
+    ]
+    s = T.summarize(T.causal_order(events))
+    # only ACCEPTED deliveries measure latency: the dedup recv of msg 1
+    # is the duplicate's arrival, not delivery, and must not skew p95
+    assert s["message_latency_s"]["n"] == 2
+    assert abs(s["message_latency_s"]["max"] - 1.0) < 1e-9
+    assert s["staleness"] == {"0": 1, "3": 1}
+    assert s["merges"] == {"count": 1, "arrivals": 2,
+                           "unique_update_ids": 2, "rejected": 0,
+                           "solo": 0, "degraded": 0}
+    assert s["per_peer"]["B"]["recv"] == {"accepted": 2, "dedup": 1}
+
+
+# --------------------------------------------------------------- invariants
+
+
+def _clean_run():
+    """A minimal 2-peer fixture that satisfies every invariant."""
+    return [
+        _send("A", 0, 10.0, to="B", msg_id=0),
+        _recv("B", 0, 10.2, src="A", msg_id=0),
+        _merge("B", 1, 11.0, version=1, arrivals=[_arrival("A", 0)],
+               component=["A", "B"], chain_len=2, head8="aa",
+               rewrite=False),
+        _merge("B", 2, 12.0, version=2, arrivals=[_arrival("A", 1)],
+               component=["A", "B"], chain_len=4, head8="bb",
+               rewrite=False),
+        _send("A", 1, 11.5, to="B", msg_id=1),
+        _recv("B", 3, 11.7, src="A", msg_id=1),
+        _end("A", 2, 20.0),
+        _end("B", 4, 20.0),
+    ]
+
+
+def test_invariants_clean_fixture_all_pass():
+    out = T.run_invariants(T.causal_order(_clean_run()))
+    assert set(out) == set(T.INVARIANTS)
+    assert all(v == [] for v in out.values()), out
+
+
+def test_double_merge_detected():
+    events = _clean_run()
+    # seed the corruption: version 2 re-merges update (A, epoch 1, msg 0)
+    events[3]["arrivals"] = [_arrival("A", 0)]
+    out = T.run_invariants(T.causal_order(events))
+    assert len(out["no_double_merge"]) == 1
+    v = out["no_double_merge"][0]
+    assert v["first_version"] == 1 and v["second_version"] == 2
+    # the SAME identity re-merged by a different leader incarnation
+    # (append-mode streams: a re-run restarts epoch/msg_id counters) is
+    # not a dedup failure — scoped by leader pid
+    remerge = _merge("B", 0, 30.0, version=1, arrivals=[_arrival("A", 0)],
+                     component=["A", "B"], chain_len=2, head8="aa",
+                     rewrite=False)
+    remerge["pid"] = 99999
+    out_fresh = T.run_invariants(T.causal_order(_clean_run() + [remerge]))
+    assert out_fresh["no_double_merge"] == []
+    # an identity-less arrival is a violation of the same rule
+    events[3]["arrivals"] = [{"peer": "A", "staleness": 0}]
+    out = T.run_invariants(T.causal_order(events))
+    assert any("identity" in v["problem"]
+               for v in out["no_double_merge"])
+
+
+def test_lost_acked_frame_detected_only_on_clean_close():
+    events = _clean_run()
+    del events[5]  # B never saw msg 1, yet A recorded it acked
+    out = T.run_invariants(T.causal_order(events))
+    assert len(out["acked_not_lost"]) == 1
+    assert out["acked_not_lost"][0]["msg_id"] == 1
+    # without B's clean close the same loss is NOT judged (a SIGKILLed
+    # receiver's unflushed tail proves nothing)
+    events2 = [e for e in events if not (e["ev"] == "run.end"
+                                         and e["peer"] == "B")]
+    out2 = T.run_invariants(T.causal_order(events2))
+    assert out2["acked_not_lost"] == []
+    # a receiver with TWO pids (killed + restarted incarnations) is not
+    # judged either, even with a run.end
+    events3 = [dict(e) for e in events]
+    for e in events3:
+        if e["peer"] == "B" and e["seq"] >= 3:
+            e["pid"] = 4242
+    out3 = T.run_invariants(T.causal_order(events3))
+    assert out3["acked_not_lost"] == []
+    # grace is judged against the send's END (start + wall_s): a chaos-
+    # retried send that only got acked AFTER the receiver's final flush
+    # may legitimately miss the receiver's stream
+    events4 = [dict(e) for e in events]
+    del events4[5]  # the recv is again missing...
+    for e in events4:
+        if e["ev"] == "send" and e.get("msg_id") == 1:
+            e["wall_s"] = 30.0  # ...but the ack landed way past B's close
+    out4 = T.run_invariants(T.causal_order(events4))
+    assert out4["acked_not_lost"] == []
+
+
+def test_causal_order_survives_real_writer_cycle():
+    # sends are emitted AFTER the ack (late seq), so a chaos dup that
+    # delivers early + a merge broadcast returning before the sender's
+    # retry loop records its send closes a genuine 4-cycle:
+    #   send_A -> recv_B -> send_B -> recv_A -> (A seq) -> send_A
+    events = [
+        _ev("recv", "A", 1, 11.2, src="B", msg_id=5, msg_epoch=1,
+            disposition="accepted"),
+        _send("A", 3, 10.0, to="B", msg_id=0),     # emitted last on A
+        _recv("B", 0, 10.1, src="A", msg_id=0),
+        _send("B", 1, 11.0, to="A", msg_id=5),
+    ]
+    ordered = T.causal_order(events)
+    assert len(ordered) == 4  # nothing dropped, no hang
+    # per-stream seq order is ground truth and must survive the break
+    a_seqs = [e["seq"] for e in ordered if e["peer"] == "A"]
+    b_seqs = [e["seq"] for e in ordered if e["peer"] == "B"]
+    assert a_seqs == sorted(a_seqs) and b_seqs == sorted(b_seqs)
+
+
+def test_cross_partition_merge_detected():
+    events = _clean_run()
+    events[2]["component"] = ["B", "C"]  # A is outside the leader's side
+    out = T.run_invariants(T.causal_order(events))
+    assert len(out["no_cross_partition_merge"]) == 1
+    assert out["no_cross_partition_merge"][0]["from_peer"] == "A"
+
+
+def test_quarantine_without_evidence_detected():
+    base = _clean_run()
+    trans = _ev("rep.transition", "B", 5, 13.0, client=2, trust=0.1,
+                **{"from": "suspect", "to": "quarantined"})
+    out = T.run_invariants(T.causal_order(base + [trans]))
+    assert len(out["quarantine_evidence"]) == 1
+    # with prior evidence in the same stream the transition is legal
+    evid = _ev("rep.evidence", "B", 4, 12.5, client=2, fault=1.0)
+    trans2 = dict(trans, seq=6)
+    out2 = T.run_invariants(T.causal_order(base + [evid, trans2]))
+    assert out2["quarantine_evidence"] == []
+
+
+def test_shrinking_chain_detected_and_rewrite_exempt():
+    events = _clean_run()
+    shrink = _ev("ledger", "B", 5, 14.0, op="append", chain_len=1,
+                 rewrite=False, head8="cc")
+    out = T.run_invariants(T.causal_order(events + [shrink]))
+    assert len(out["monotone_heads"]) == 1
+    assert out["monotone_heads"][0]["prev_len"] == 4
+    # the same shrink flagged as a declared rewrite (fork-merge adoption /
+    # full resync) is legal
+    rewrite = dict(shrink, op="resync", rewrite=True)
+    out2 = T.run_invariants(T.causal_order(events + [rewrite]))
+    assert out2["monotone_heads"] == []
+    # a NEW process incarnation (append-mode streams: a re-run into the
+    # same dir, a within-run restart) starts its own baseline — its short
+    # fresh chain is not a shrink of its predecessor's
+    fresh = dict(_ev("ledger", "B", 0, 30.0, op="commit", chain_len=1,
+                     rewrite=False, head8="dd"), pid=99999)
+    out3 = T.run_invariants(T.causal_order(events + [fresh]))
+    assert out3["monotone_heads"] == []
+
+
+# -------------------------------------------------------- global emit seam
+
+
+def test_global_emit_is_noop_until_installed(tmp_path):
+    T.uninstall()
+    T.emit("round", round=0, wall_s=0.1)  # must not raise, writes nowhere
+    path = str(tmp_path / "events_engine.jsonl")
+    T.install(T.EventWriter(path, peer=None, run="g"))
+    T.emit("round", round=1, wall_s=0.1)
+    T.uninstall()
+    events, _ = T.read_stream(path)
+    assert [e["round"] for e in events] == [1]
+
+
+def test_collate_run_over_directory(tmp_path):
+    for p in (0, 1):
+        w = T.EventWriter(str(tmp_path / f"events_peer{p}.jsonl"), peer=p)
+        w.emit("run.start", role="peer", peers=2)
+        w.emit("run.end", status="ok")
+        w.close()
+    col = T.collate_run(str(tmp_path))
+    assert len(col["streams"]) == 2
+    assert col["ok"] and col["invariant_violations_total"] == 0
+    assert len(col["ordered"]) == 4
+
+
+# --------------------------------------------- local engine end-to-end
+
+
+def test_engine_streams_events_and_collates(tmp_path):
+    """A real (tiny) local engine run with telemetry_dir set: the stream
+    carries run lifecycle, per-round spans, StepClock phases, ledger
+    commits with monotone chain growth, reputation evidence, and
+    checkpoint saves — and the collator finds zero invariant violations."""
+    import tests.conftest  # noqa: F401  (8-device CPU mesh)
+    from bcfl_tpu.config import FedConfig, LedgerConfig, PartitionConfig
+    from bcfl_tpu.fed.engine import FedEngine
+    from bcfl_tpu.faults import FaultPlan
+    from bcfl_tpu.reputation import ReputationConfig
+
+    tdir = str(tmp_path / "tel")
+    cfg = FedConfig(
+        name="tel_engine", dataset="synthetic", model="tiny-bert",
+        num_clients=4, num_rounds=3, seq_len=16, batch_size=4,
+        max_local_batches=2, mode="server", eval_every=0,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        reputation=ReputationConfig(enabled=True),
+        faults=FaultPlan(seed=3, flaky_clients=(1,), flaky_burst_len=1,
+                         flaky_on_prob=1.0),
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        telemetry_dir=tdir)
+    FedEngine(cfg).run()
+
+    assert T.get_writer() is None  # run() uninstalled its writer
+    col = T.collate_run(tdir)
+    assert col["ok"], col["violations"]
+    ordered = col["ordered"]
+    kinds = [e["ev"] for e in ordered]
+    assert kinds[0] == "run.start" and kinds[-1] == "run.end"
+    assert ordered[-1]["status"] == "ok"
+    assert kinds.count("round") == cfg.num_rounds
+    # StepClock phases stream as typed spans
+    names = {e["name"] for e in ordered if e["ev"] == "phase"}
+    assert {"control_plane", "round_program", "ledger"} <= names
+    # ledger commits: one per round, chain strictly growing
+    commits = [e for e in ordered
+               if e["ev"] == "ledger" and e["op"] == "commit"]
+    assert len(commits) == cfg.num_rounds
+    lens = [e["chain_len"] for e in commits]
+    assert lens == sorted(lens) and lens[-1] == 4 * cfg.num_rounds
+    # the flaky corrupter produced reputation evidence events
+    assert any(e["ev"] == "rep.evidence" and e["client"] == 1
+               for e in ordered)
+    assert any(e["ev"] == "ckpt.save" for e in ordered)
+
+
+# ------------------------------------------------------- ResourceMonitor fix
+
+
+def test_resource_monitor_primed_baseline():
+    """The first psutil cpu_percent call always returns a meaningless 0.0;
+    the monitor must discard it (priming) rather than store it as a
+    'before' reading, and snapshot() must return the windowed value."""
+    from bcfl_tpu.metrics import ResourceMonitor
+
+    m = ResourceMonitor()
+    assert not hasattr(m, "cpu_before")  # the bogus stored 0.0 is gone
+    sum(i * i for i in range(200_000))   # burn a little CPU in-window
+    snap = m.snapshot()
+    assert snap["cpu_percent"] >= 0.0
+    assert snap["latency_min"] >= 0.0

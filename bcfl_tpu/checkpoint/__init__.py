@@ -1,1 +1,5 @@
-from bcfl_tpu.checkpoint.checkpoint import save_checkpoint, restore_latest  # noqa: F401
+from bcfl_tpu.checkpoint.checkpoint import (  # noqa: F401
+    restore_checkpoint,
+    restore_latest,
+    save_checkpoint,
+)

@@ -162,6 +162,36 @@ def _read_meta(directory: str, round_idx: int) -> Optional[Dict[str, Any]]:
         return None
 
 
+def restore_checkpoint(directory: str, round_idx: int
+                       ) -> Optional[Tuple[Dict[str, Any], Optional[str]]]:
+    """``(state, ledger_json)`` of ONE specific committed checkpoint, or
+    None if it is absent/unrestorable. Unlike :func:`restore_latest` this
+    does not fall back to an older round — it is the forensic read the
+    proof harnesses use to compare a specific durable state against what
+    a resumed process reports having restored (bit-identical-restore
+    gates in scripts/dist_byzantine.py)."""
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, f"round_{int(round_idx):06d}")
+    if not os.path.isdir(path):
+        return None
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            state = ckptr.restore(path)
+    except Exception as e:  # truncated/partial tree
+        logger.warning("checkpoint %s failed to restore (%s)", path, e)
+        return None
+    meta = _read_meta(directory, int(round_idx))
+    if meta is not None and meta.get("digest"):
+        if _state_digest(state) != meta["digest"]:
+            # the same integrity bar as restore_latest: ground truth that
+            # fails its own committed digest is not ground truth — a
+            # bit-identity gate comparing against it would fail (or pass)
+            # for the wrong reason
+            logger.warning("checkpoint %s params digest mismatch", path)
+            return None
+    return state, (meta.get("ledger") if meta is not None else None)
+
+
 def restore_latest(directory: str) -> Optional[Tuple[int, Dict[str, Any], Optional[str]]]:
     """(round, state, ledger_json) of the newest VALID checkpoint, or None.
 

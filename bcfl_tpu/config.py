@@ -109,10 +109,13 @@ class DistConfig:
     # first listen port; peer p listens on base_port + p. 0 = the spawner
     # picks free ports and passes them down (scripts/dist_async.py, CLI)
     base_port: int = 0
-    # updates buffered per aggregation event at a component leader, in PEER
-    # updates (each carrying that peer's whole client slice). 0 = 1: merge
-    # on every arrival — the pure-async setting, and the one that makes the
-    # measured staleness distribution non-degenerate. Must be <= peers.
+    # merge target at a component leader, in DISTINCT sending peers (each
+    # update carries its sender's whole client slice; several updates from
+    # one sender count once toward the target and collapse into one vote
+    # under a robust aggregator — the "f of k" arithmetic is over peers).
+    # 0 = 1: merge on every arrival — the pure-async setting, and the one
+    # that makes the measured staleness distribution non-degenerate. Must
+    # be <= peers.
     buffer: int = 0
     # leader-side cap on waiting for the buffer to fill: merge whatever
     # arrived once this many seconds pass since the first buffered update
@@ -272,17 +275,18 @@ RUNTIME_CAPS: Tuple = (
               "per-round view to filter"}),
     ("reputation lifecycle",
      lambda c: c.reputation.enabled,
-     {"local": True,
-      "dist": "the lifecycle tracker consumes a global per-round evidence "
-              "view; dist evidence is per-component and asynchronous — "
-              "not implemented"}),
+     {"local": True, "dist": True}),  # dist: per-PEER tracker fed by wire
+    # evidence (ledger refingerprint mismatches, robust-merge outlier
+    # flags, staleness/replay, detector transitions); quarantine refusals
+    # are post-ack gate drops and transitions commit to the ledger
+    # (bcfl_tpu.reputation.dist, RUNTIME.md §5)
     ("robust aggregators",
      lambda c: c.aggregator != "mean",
-     {"local": True,
-      "dist": "the buffered FedBuff merge is a host-side staleness-"
-              "weighted mean over arrived peer updates; the robust order "
-              "statistics are compiled device programs over a fixed "
-              "stacked axis — not implemented for the dist merge"}),
+     {"local": True, "dist": True}),  # dist: the robust rules run host-
+    # side over the buffered ARRIVAL set (bcfl_tpu.dist.robust) —
+    # supported WITH declared preconditions on the merge buffer, enforced
+    # below at config time (trimmed_mean/median need buffer >= 3; krum
+    # needs buffer >= 2f+3 for f = ceil(trim * buffer))
     ("communication compression",
      lambda c: c.compression.enabled,
      {"local": True, "dist": True}),
@@ -317,6 +321,16 @@ RUNTIME_CAPS: Tuple = (
                "transport (PeerTransport); use corrupt_prob for the "
                "simulated-transport analogue",
       "dist": True}),
+    ("chaos: byzantine peers",
+     lambda c: c.faults.byz_enabled,
+     {"local": "byzantine behaviors forge the dist update exchange's wire "
+               "headers and payloads (stale lineage, digest forgeries, "
+               "per-destination equivocation); the local engine exchanges "
+               "none of those — use corrupt_prob/flaky_* for the "
+               "simulated in-graph analogue",
+      "dist": True}),  # injected above the wire (dist/byzantine.py),
+    # composable with the wire lane; ROBUSTNESS.md §8 names what evidence
+    # catches each behavior
     ("chaos: churn",
      lambda c: c.faults.churns,
      {"local": True,
@@ -600,6 +614,55 @@ class FedConfig:
                 raise ValueError(
                     f"dist partition_count {self.faults.partition_count} "
                     f"> peers {self.dist.peers}")
+            if self.faults.byz_enabled:
+                bad = [p for p in self.faults.byz_peers
+                       if p >= self.dist.peers]
+                if bad:
+                    raise ValueError(
+                        f"byz_peers name PEERS; ids {bad} are >= peers="
+                        f"{self.dist.peers}")
+                if len(self.faults.byz_peers) >= self.dist.peers:
+                    raise ValueError(
+                        "byz_peers lists EVERY peer: an all-adversarial "
+                        "federation has no honest majority for any rule "
+                        "to defend — leave at least one peer honest")
+            if self.aggregator != "mean":
+                # robust aggregators are supported on dist WITH declared
+                # preconditions on the merge buffer (RUNTIME.md §5): the
+                # arrival set is the estimator's population, so the
+                # buffer target must be large enough for the rule's
+                # breakdown point to mean anything. Quorum degradation
+                # can still shrink a given merge below these minima at
+                # runtime — such merges aggregate with clamped trim and
+                # are recorded `robust_degraded`.
+                # the precondition math lives in bcfl_tpu.dist.robust
+                # (MIN_ORDER_VOTES / krum_min_buffer) — the same source
+                # the runtime's robust_degraded threshold reads, so
+                # config-time acceptance and runtime grading can't drift
+                from bcfl_tpu.dist.robust import (
+                    MIN_ORDER_VOTES,
+                    krum_min_buffer,
+                )
+
+                eff = self.dist.buffer or 1
+                if self.aggregator in ("trimmed_mean", "median"):
+                    if eff < MIN_ORDER_VOTES:
+                        raise ValueError(
+                            f"aggregator={self.aggregator!r} on "
+                            f"runtime='dist' needs dist.buffer >= "
+                            f"{MIN_ORDER_VOTES} (got {eff}): the rule's "
+                            "population is the buffered arrival set, and "
+                            f"an order statistic over < {MIN_ORDER_VOTES} "
+                            "votes excludes nothing")
+                if self.aggregator == "krum":
+                    need = krum_min_buffer(eff, self.aggregator_trim)
+                    if eff < need:
+                        raise ValueError(
+                            f"aggregator='krum' on runtime='dist' needs "
+                            f"dist.buffer >= 2f+3 = {need} for f = "
+                            f"ceil(aggregator_trim * buffer) "
+                            f"(got buffer {eff}): below that the "
+                            "classical selection guarantee is vacuous")
         if self.num_clients < 1 or self.num_rounds < 1:
             raise ValueError("num_clients and num_rounds must be >= 1")
         if self.eval_every < 0:

@@ -1,0 +1,182 @@
+"""Adversarial-peer injection for the dist runtime — the byzantine lane.
+
+The wire lane (PR 8) attacks the NETWORK: frames are dropped, duplicated,
+corrupted in flight — and the CRC/retry/dedup transport heals all of it,
+because a damaged frame is detectably damaged. This module attacks the
+PEER: a :class:`ByzantineAdversary` rewrites its own outbound updates
+*above* the wire, so every frame is well-formed, correctly CRC'd, acked,
+and deduped — the transport delivers the lie perfectly. What catches it is
+the application layer this PR adds: the leader's refingerprint-on-arrival
+(ledger evidence), the robust buffered merge (outlier evidence), the
+measured-staleness lineage checks (replay evidence), and the
+:class:`bcfl_tpu.reputation.dist.DistReputationTracker` that folds all of
+it into quarantine.
+
+Behaviors (drawn per (peer, round) by :meth:`FaultPlan.byz_action`,
+ROBUSTNESS.md §8 "Adversary model"):
+
+- ``scale`` / ``sign_flip`` / ``garbage`` — **poisoning**: the payload's
+  float parts are scaled / negated / replaced with seeded noise, and the
+  announced digests are RE-COMPUTED over the poisoned payload (the caller
+  re-fingerprints), so ledger authentication PASSES — this is the attack
+  only the robust merge rules and the outlier evidence can catch,
+- ``digest_forge`` — **forgery**: the announced digests stay the honest
+  payload's, the shipped bytes are poisoned — announce one fingerprint,
+  ship another; the leader's commit→refingerprint→verify order catches it
+  as a per-client auth failure (the hard evidence lane),
+- ``replay`` — **staleness attack**: an earlier update (header AND
+  payload, recorded verbatim at send time) is resent under a fresh
+  transport identity; the stale ``base_version``/``lineage`` either
+  rejects at the leader's lineage check or merges at an outlier staleness
+  — both are reputation evidence,
+- ``equivocate`` — **split-brain**: the payload each DESTINATION receives
+  is perturbed with destination-keyed seeded noise under one announced
+  digest, so two receivers of "the same" update hold different bytes and
+  each sees its own digest mismatch.
+
+Determinism contract (pinned in tests/test_dist_byzantine.py): identical
+``(plan seed, round, peer, destination)`` coordinates always produce the
+identical mutated bytes, and a disabled lane returns the caller's objects
+UNTOUCHED (the clean-twin bit-match gate) — the lane is exactly as absent
+as its config says.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu import telemetry
+
+
+def _map_floats(tree, fn):
+    """Apply ``fn`` to every float ndarray leaf of a (nested dict) host
+    tree — the same "perturb the float parts" semantics as the corruption
+    lanes: quantized int8 codes / int payloads ride along untouched, the
+    scales/values that reconstruct the update are what get poisoned."""
+    if isinstance(tree, dict):
+        return {k: _map_floats(v, fn) for k, v in tree.items()}
+    arr = np.asarray(tree)
+    if np.issubdtype(arr.dtype, np.floating):
+        return fn(arr)
+    return tree
+
+
+class ByzantineAdversary:
+    """Binds the FaultPlan byzantine lane to ONE peer process.
+
+    Constructed by every peer (cheap); :meth:`corrupt_update` is the one
+    injection seam — a no-op identity for honest peers and disabled lanes.
+    ``clock_fn`` is the peer's local round (the same autonomous span clock
+    the partition/wire lanes use)."""
+
+    #: how many of its own past sends the adversary remembers for replay
+    REPLAY_DEPTH = 8
+
+    def __init__(self, plan: Optional[FaultPlan], peer_id: int,
+                 clock_fn: Callable[[], int]):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.peer_id = int(peer_id)
+        self.clock_fn = clock_fn
+        # (header, wire_tree) of past HONEST sends, oldest first — the
+        # replay corpus (deep copies: the runtime mutates nothing, but a
+        # replayed header must carry the ORIGINAL round/base/lineage)
+        self._history: List[Tuple[Dict, Dict]] = []
+        self.injected: Dict[str, int] = {b: 0 for b in
+                                         self.plan.byz_behaviors}
+
+    @property
+    def armed(self) -> bool:
+        return (self.plan.byz_enabled
+                and self.peer_id in (self.plan.byz_peers or ()))
+
+    def corrupt_update(self, header: Dict, wire_tree: Dict,
+                       dst: int) -> Tuple[Dict, Dict, Optional[Dict]]:
+        """Maybe-rewrite one outbound update bound for peer ``dst``.
+
+        Returns ``(header, wire_tree, action)`` — the INPUT objects,
+        untouched, with ``action=None`` when the peer behaves honestly
+        this round (lane off / not this peer / span not due / prob draw);
+        otherwise fresh mutated copies plus the drawn action dict.
+        ``action["reannounce"]`` tells the caller whether the announced
+        digests must be recomputed over the mutated payload (the
+        poisoning behaviors, which must PASS ledger auth) or left as the
+        honest announcement (forgery/equivocation, which must FAIL the
+        leader's refingerprint)."""
+        rnd = int(self.clock_fn())
+        act = self.plan.byz_action(rnd, self.peer_id)
+        if not self.armed or act is None:
+            # honest this round: record it as replay corpus and pass the
+            # caller's objects through IDENTICALLY (bit-match contract)
+            if self.armed:
+                self._remember(header, wire_tree)
+            return header, wire_tree, None
+        behavior = act["behavior"]
+        scale = act["scale"]
+        rng = self.plan.byz_rng(rnd, self.peer_id, int(dst))
+        if behavior == "replay" and not self._history:
+            # nothing recorded yet to replay: behave HONESTLY this round
+            # (recording it as corpus) rather than substitute a behavior
+            # the plan may have excluded — at byz_prob=1.0 this is every
+            # adversary's first acting round, after which the corpus is
+            # never empty again (acting rounds record their honest input
+            # below)
+            self._remember(header, wire_tree)
+            return header, wire_tree, None
+        out_header, out_tree = dict(header), wire_tree
+        reannounce = False
+        if behavior == "scale":
+            out_tree = _map_floats(wire_tree,
+                                   lambda a: (a * scale).astype(a.dtype))
+            reannounce = True
+        elif behavior == "sign_flip":
+            out_tree = _map_floats(wire_tree, lambda a: -a)
+            reannounce = True
+        elif behavior == "garbage":
+            out_tree = _map_floats(
+                wire_tree,
+                lambda a: (rng.standard_normal(a.shape) * scale).astype(
+                    a.dtype))
+            reannounce = True
+        elif behavior == "digest_forge":
+            # announce the honest digests, ship a poisoned payload: the
+            # leader's refingerprint of what ARRIVED must mismatch
+            out_tree = _map_floats(wire_tree,
+                                   lambda a: (a * scale).astype(a.dtype))
+        elif behavior == "equivocate":
+            # destination-keyed noise under the honest announcement: two
+            # destinations receive different bytes for "one" update
+            out_tree = _map_floats(
+                wire_tree,
+                lambda a: (a + rng.standard_normal(a.shape)).astype(
+                    a.dtype))
+        elif behavior == "replay":
+            old_header, old_tree = self._history[0]
+            # the stale header verbatim (old round/base_version/lineage/
+            # digests/sent_at) — the transport stamps a fresh msg identity
+            out_header = dict(old_header)
+            out_tree = copy.deepcopy(old_tree)
+        # every round's HONEST input feeds the replay corpus — an
+        # always-acting adversary (byz_prob=1.0, the harness default)
+        # must still accumulate stale updates to resend
+        self._remember(header, wire_tree)
+        self.injected[behavior] = self.injected.get(behavior, 0) + 1
+        telemetry.emit("byz.inject", behavior=behavior, round=rnd,
+                       dst=int(dst), reannounce=reannounce)
+        return out_header, out_tree, dict(act, behavior=behavior,
+                                          reannounce=reannounce)
+
+    def _remember(self, header: Dict, wire_tree: Dict) -> None:
+        self._history.append((copy.deepcopy(header),
+                              copy.deepcopy(wire_tree)))
+        while len(self._history) > self.REPLAY_DEPTH:
+            self._history.pop(0)
+
+    def stats(self) -> Dict:
+        """Per-behavior injection counts for the peer report (the baseline
+        legs gate these at exactly zero with the lane off)."""
+        return {"armed": self.armed, "injected": dict(self.injected),
+                "total": int(sum(self.injected.values()))}

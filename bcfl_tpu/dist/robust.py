@@ -1,0 +1,183 @@
+"""Byzantine-robust aggregation over the FedBuff ARRIVAL set (host side).
+
+The local engine compiles trimmed_mean/median/krum into device programs
+over a FIXED stacked client axis (``bcfl_tpu.parallel.gspmd``) — that is
+why the capability table used to reject them on ``runtime="dist"``: the
+buffered merge's arrival set has a different, runtime-variable population
+(one entry per buffered PEER update, its size set by arrival order and
+quorum). This module is the port: the same estimators, re-expressed over
+the host-side arrival trees the leader already holds at merge time.
+
+Semantics (the dist twin of ROBUSTNESS.md §2, declared differences):
+
+- each PEER contributes ONE vote — its buffered updates are first
+  weight-combined into one delta (:func:`combine_votes`; each update is
+  that peer's collapsed client-slice delta, auth/trust masked) — so
+  ``k`` is the number of distinct senders in the merge, and the "f of k
+  are Byzantine" breakdown arithmetic is over peers, never inflatable by
+  one sender's message rate,
+- ``weights`` (staleness decay × examples × auth × trust, summed over the
+  slice) act as a PARTICIPATION mask for the order statistics, exactly
+  like the local rules: a positive weight is a full vote, zero is
+  excluded. The applied global step still shrinks with staleness via the
+  ``_async_merge_scale`` rescale in the runtime — staleness dampens the
+  step, not the vote,
+- a merge with fewer arrivals than the config-time precondition (quorum
+  degradation, buffer timeout) still aggregates — the estimators clamp
+  their trim exactly like the device versions — but the runtime records
+  it ``robust_degraded`` (the guarantee, not the math, degraded).
+
+Besides the aggregate, every call returns per-arrival **outlier flags**:
+arrivals whose delta sits far from the robust aggregate (squared distance
+> ``OUTLIER_MULT`` × the median arrival distance, only judged for k >= 3).
+These are the "robust-aggregator outlier flags" evidence lane the
+DistReputationTracker consumes — the poisoning behaviors (scaled /
+sign-flipped / garbage payloads re-announce matching digests, so ledger
+auth passes) are visible ONLY here.
+
+Everything is plain numpy over trees the merge already materialized: the
+arrival set is small (<= peers), so no device program or retrace concern
+exists on this path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# an arrival whose squared distance to the robust aggregate exceeds this
+# multiple of the median arrival distance is flagged as an outlier
+# (evidence, not exclusion — exclusion is the aggregator's own job)
+OUTLIER_MULT = 4.0
+
+RULES = ("trimmed_mean", "median", "krum")
+
+# minimum distinct peer votes for an order statistic to exclude anything
+# — the trimmed_mean/median config-time precondition AND the runtime's
+# robust_degraded threshold (one source, so the two can't drift)
+MIN_ORDER_VOTES = 3
+
+
+def _flatten(tree) -> np.ndarray:
+    """Concatenate every leaf of a (nested dict) host tree into one f64
+    vector, in sorted-key order (deterministic across arrivals — all
+    arrivals share one tree structure)."""
+    if isinstance(tree, dict):
+        parts = [_flatten(tree[k]) for k in sorted(tree)]
+        return (np.concatenate(parts) if parts
+                else np.zeros((0,), np.float64))
+    return np.asarray(tree, np.float64).reshape(-1)
+
+
+def _unflatten_like(tree, flat: np.ndarray, pos: int = 0):
+    if isinstance(tree, dict):
+        out = {}
+        for k in sorted(tree):
+            out[k], pos = _unflatten_like(tree[k], flat, pos)
+        return out, pos
+    arr = np.asarray(tree)
+    n = arr.size
+    return flat[pos:pos + n].reshape(arr.shape).astype(arr.dtype), pos + n
+
+
+def trim_count(k: int, trim: float) -> int:
+    """ceil(trim * k) clamped so at least one vote survives — the same
+    clamp as the device ``gspmd._trim_count``."""
+    t = int(math.ceil(trim * k))
+    return max(0, min(t, (k - 1) // 2))
+
+
+def krum_min_buffer(buffer: int, trim: float) -> int:
+    """The classical Krum precondition ``k >= 2f + 3`` for a buffer of
+    ``k`` arrivals under an assumed Byzantine fraction ``trim`` —
+    config-time validation quotes this."""
+    return 2 * int(math.ceil(trim * buffer)) + 3
+
+
+def combine_votes(deltas: List, weights: List[float]):
+    """Weighted mean of ONE peer's buffered update deltas — the peer's
+    single vote. The robust rules' breakdown point is stated over PEERS
+    (``f`` of ``k`` participants are Byzantine), so a sender that parks
+    several updates in one merge window must still speak with one voice:
+    without this collapse, a fast adversary could outvote the honest
+    cohort simply by sending more often than anyone else."""
+    if not deltas:
+        raise ValueError("combine_votes needs at least one delta")
+    w = np.asarray(weights, np.float64)
+    total = float(w.sum())
+    w = (w / total) if total > 0 else np.full_like(w, 1.0 / len(deltas))
+    X = np.stack([_flatten(d) for d in deltas])
+    out, _ = _unflatten_like(deltas[0], (w[:, None] * X).sum(axis=0))
+    return out
+
+
+def robust_merge(deltas: List, weights: List[float], rule: str,
+                 trim: float = 0.2) -> Tuple[Dict, List[bool], Dict]:
+    """Aggregate the arrival set with a robust rule.
+
+    ``deltas`` are the per-update collapsed delta trees (host numpy, one
+    per buffered update), ``weights`` their total merge weights (used as
+    the participation mask; zero-weight arrivals are excluded and
+    auto-flagged). Returns ``(aggregate_tree, outlier_flags, info)`` where
+    ``info`` records the realized estimator parameters for the merge
+    record (``k``, ``trim_t`` / ``krum_selected`` / ``krum_scores``).
+    ``krum_selected`` is a POSITION in ``deltas`` — a caller whose votes
+    map to senders must translate it (the runtime records the peer id as
+    ``krum_selected_peer``)."""
+    if rule not in RULES:
+        raise ValueError(f"unknown robust rule {rule!r} (one of {RULES})")
+    if not deltas:
+        raise ValueError("robust_merge needs at least one arrival")
+    X = np.stack([_flatten(d) for d in deltas])  # [k_all, D]
+    w = np.asarray(weights, np.float64)
+    active = w > 0
+    idx = np.nonzero(active)[0]
+    k = int(idx.size)
+    info: Dict = {"rule": rule, "k": k}
+    if k == 0:
+        # every arrival eliminated (auth/trust): nothing to aggregate —
+        # the caller treats this like the all-masked degraded round
+        return None, [False] * len(deltas), dict(info, empty=True)
+    A = X[idx]
+    if rule == "trimmed_mean":
+        t = trim_count(k, trim)
+        info["trim_t"] = t
+        S = np.sort(A, axis=0)
+        agg = S[t:k - t].mean(axis=0)
+    elif rule == "median":
+        agg = np.median(A, axis=0)
+    else:  # krum
+        sq = (A * A).sum(axis=1)
+        D = sq[:, None] + sq[None, :] - 2.0 * (A @ A.T)
+        np.fill_diagonal(D, np.inf)
+        D = np.maximum(D, 0.0)
+        f = trim_count(k, trim)
+        m = max(k - f - 2, 1)
+        scores = np.sort(D, axis=1)[:, :m].sum(axis=1)
+        sel = int(np.argmin(scores))
+        info["krum_selected"] = int(idx[sel])
+        info["krum_scores"] = [float(s) for s in scores]
+        agg = A[sel]
+    # outlier evidence: distance of every ACTIVE arrival to the aggregate,
+    # judged against the cohort's own scale (median distance). k < 3 has
+    # no meaningful cohort to stand out from — no flags, no false
+    # evidence from a degraded two-arrival merge. Zero-weight arrivals
+    # are NOT flagged: they were excluded (auth/trust), which is its own
+    # already-recorded evidence, not an outlier observation.
+    flags = [False] * len(deltas)
+    if k >= 3:
+        d2 = ((A - agg[None, :]) ** 2).sum(axis=1)
+        med = float(np.median(d2))
+        floor = 1e-12
+        # aligned with `deltas` (None for excluded arrivals), so callers
+        # can zip distances against the arrival records directly
+        dist_full: List = [None] * len(deltas)
+        for j, i in enumerate(idx):
+            dist_full[int(i)] = float(d2[j])
+            if d2[j] > OUTLIER_MULT * max(med, floor):
+                flags[int(i)] = True
+        info["distances"] = dist_full
+    out, _ = _unflatten_like(deltas[0], agg)
+    return out, flags, info

@@ -77,18 +77,47 @@ class MergeRecord:
     solo: bool  # produced while partitioned (a fork extension)
     degraded: bool = False  # merged on a reduced quorum (some peer DOWN)
     quorum: Optional[Dict] = None  # {"component", "alive", "down"} when degraded
+    robust: Optional[Dict] = None  # robust-rule info (k, trim_t/krum_*) when armed
+    robust_degraded: bool = False  # fewer arrivals than the declared precondition
+
+
+def measured_staleness(leader_version: int, base_version: int):
+    """``(staleness, clamped)`` of one arrival: the leader's version minus
+    the sender's base version, clamped to >= 0.
+
+    The raw difference CAN be negative after a leader restart: the leader
+    restores the newest durable checkpoint, whose version counter may sit
+    BELOW the base version a concurrent sender already adopted from a
+    later (lost-to-the-crash) broadcast. ``decay ** negative`` would
+    INFLATE that update's merge weight (1/decay per lost version) — the
+    opposite of what staleness decay is for — so the exponent clamps to 0
+    (a from-the-future update is at worst "fresh") and the clamp is
+    surfaced (``clamped=True`` -> a `warn` telemetry event + the arrival
+    record) instead of silently normalizing the disagreement away."""
+    raw = int(leader_version) - int(base_version)
+    return max(raw, 0), raw < 0
 
 
 def _peer_engine_cfg(cfg, local_clients: int):
     """The embedded per-peer engine config: the peer's own client slice on a
     plain local mesh. The dist layer owns async/partition/eval semantics, so
     the inner engine runs the vanilla sync-server build (its round LOOP is
-    never used — only its data/program/ledger/exchange machinery)."""
+    never used — only its data/program/ledger/exchange machinery).
+
+    The aggregator is pinned to "mean": the robust rules on this runtime
+    act over the buffered ARRIVAL set host-side (bcfl_tpu.dist.robust),
+    while the inner engine's ``collapse`` program must stay the plain
+    weighted mean that reduces one peer's client slice to its vote.
+    Reputation is likewise pinned off: the dist layer runs its own
+    per-PEER tracker (bcfl_tpu.reputation.dist); the engine's per-client
+    lifecycle has no role inside a peer."""
     from bcfl_tpu.faults import FaultPlan
+    from bcfl_tpu.reputation import ReputationConfig
 
     return cfg.replace(
         runtime="local", sync="sync", mode="server",
         num_clients=local_clients, eval_every=0,
+        aggregator="mean", reputation=ReputationConfig(),
         faults=FaultPlan(),  # partition/straggler lanes act at the transport
         checkpoint_dir=None, checkpoint_every=0,
         rounds_per_dispatch=1, donate=False)
@@ -159,12 +188,54 @@ class PeerRuntime:
         self._below_quorum_events = 0  # episodes, not loop polls
         self._buffer: List[tuple] = []  # (header, trees, recv_time)
         self._buffer_shed = 0  # oldest entries shed by the intake cap
+        # when the CURRENT merge window opened (first entry into an empty
+        # buffer): the buffer_timeout_s clock. Deliberately not the oldest
+        # surviving entry's timestamp — the intake cap sheds oldest-first,
+        # so under flood that timestamp keeps advancing and a timeout
+        # measured from it can never fire (a dead peer holding
+        # distinct < want would park merges forever)
+        self._buffer_since = 0.0
         self._partitioned = False
         self._fork_comps = None
         self._pending_reconcile = False
         self._last_reconcile_try = 0.0
         self._stop = False
         self._resumed = False
+
+        # per-PEER reputation (reputation/dist.py): wire evidence ->
+        # quarantine, transitions committed to the chain, state
+        # checkpointed bit-for-bit. Every peer runs one; the leader's is
+        # the one that gates merges.
+        self.rep = None
+        if cfg.reputation.enabled:
+            from bcfl_tpu.reputation.dist import DistReputationTracker
+
+            self.rep = DistReputationTracker(cfg.reputation, self.peers,
+                                             self.peer_id)
+        self._det_seen = 0  # detector transitions already fed as evidence
+        # byzantine lane (dist/byzantine.py): constructed only when the
+        # plan arms it — the injection seam in _train_once is otherwise
+        # absent, not merely inert
+        self.byz = None
+        if cfg.faults.byz_enabled:
+            from bcfl_tpu.dist.byzantine import ByzantineAdversary
+
+            self.byz = ByzantineAdversary(
+                cfg.faults, self.peer_id,
+                clock_fn=lambda: self.local_round)
+        # the robust rules' declared arrival-count precondition (validated
+        # against cfg.dist.buffer at config time); a merge below it still
+        # aggregates with clamped trim but is recorded robust_degraded
+        self._robust_min = 0
+        if cfg.aggregator != "mean":
+            from bcfl_tpu.dist.robust import (
+                MIN_ORDER_VOTES,
+                krum_min_buffer,
+            )
+
+            self._robust_min = (
+                krum_min_buffer(cfg.dist.buffer or 1, cfg.aggregator_trim)
+                if cfg.aggregator == "krum" else MIN_ORDER_VOTES)
 
         plan = cfg.faults if cfg.faults.partitions else None
         # the span clock is the peer's LOCAL ROUND: it advances autonomously
@@ -358,6 +429,20 @@ class PeerRuntime:
             time.sleep(float(delays[self.peer_id]))
 
         leader = self._leader()
+        if self.byz is not None:
+            # the byzantine lane's ONE injection seam: above the wire,
+            # below the honest training — the frame the transport ships is
+            # well-formed, the content lies (dist/byzantine.py). The
+            # poisoning behaviors re-announce digests over the mutated
+            # payload so ledger auth PASSES (the robust merge catches
+            # them); forgery/equivocation keep the honest announcement so
+            # the leader's refingerprint fails (the ledger catches them).
+            header, wire_tree, act = self.byz.corrupt_update(
+                header, wire_tree, dst=leader)
+            if act is not None and act["reannounce"] and header.get(
+                    "digests") is not None:
+                header = dict(header, digests=self._announce_digests(
+                    header["wire_kind"], wire_tree))
         if leader == self.peer_id:
             # the leader's own update gets a real (from, msg_id) identity
             # too, so EVERY merged update is dedup-accountable
@@ -372,6 +457,15 @@ class PeerRuntime:
             # the next global broadcast
             self.transport.send(leader, header, {"payload": wire_tree})
 
+    def _announce_digests(self, wire_kind: str, tree_np) -> List[str]:
+        """Per-client entry digests of a wire payload, recomputed through
+        the same device fingerprint program the honest announcement uses —
+        what the poisoning behaviors re-announce so their mutated payload
+        authenticates."""
+        fp = np.asarray(self.eng.progs.fingerprint(self._to_device(tree_np)))
+        return [self.eng._entry_digest(wire_kind, fp[c]).hex()
+                for c in range(self.local_clients)]
+
     # ------------------------------------------------------- leader: merging
 
     def _buffer_push(self, entry: tuple):
@@ -382,6 +476,8 @@ class PeerRuntime:
         (its stale lineage would be the first rejected at the eventual
         merge anyway)."""
         cap = max(4, 2 * self.peers, 2 * (self.cfg.dist.buffer or 1))
+        if not self._buffer:
+            self._buffer_since = entry[2]  # a new merge window opens
         self._buffer.append(entry)
         while len(self._buffer) > cap:
             self._buffer.pop(0)
@@ -403,7 +499,17 @@ class PeerRuntime:
         states = self.transport.detector.states()
         down = [p for p in comp
                 if p != self.peer_id and states.get(p) == DOWN]
-        alive = [p for p in comp if p not in down]
+        # QUARANTINED peers count like DOWN ones toward the merge target:
+        # their arrivals are refused post-ack, so waiting buffer_timeout_s
+        # for updates that can never buffer would hand the adversary a
+        # denial-of-service for free. They still count against the quorum
+        # DENOMINATOR — quarantining more than (1 - quorum_frac) of the
+        # component parks the leader, by design (a distrusted majority is
+        # not a quorum).
+        quarantined = ([p for p in self.rep.quarantined_peers()
+                        if p in comp and p != self.peer_id]
+                       if self.rep is not None else [])
+        alive = [p for p in comp if p not in down and p not in quarantined]
         if len(alive) < max(1, math.ceil(cfg.dist.quorum_frac * len(comp))):
             # count EPISODES (entries into the below-quorum state), not
             # main-loop polls — the surfaced number must not depend on
@@ -425,10 +531,17 @@ class PeerRuntime:
         self._below_quorum = False
         if not self._buffer:
             return
+        # the buffer target counts DISTINCT senders, not buffered entries:
+        # a fast peer (or a flooding adversary) can park several of its own
+        # updates before a slow peer lands one, and a robust rule graded
+        # on "f of k votes are bad" is only meaningful when the vote
+        # population is PEERS — k entries from one sender are one voice
+        # (and one vote: _apply_robust_merge groups by sender). The
+        # buffer_timeout still bounds the wait for stragglers.
         want = min(cfg.dist.buffer or 1, len(alive))
-        first_ts = self._buffer[0][2]
-        if (len(self._buffer) < want
-                and time.time() - first_ts < cfg.dist.buffer_timeout_s):
+        distinct = len({int(h.get("from", -1)) for h, _, _ in self._buffer})
+        if (distinct < want and time.time() - self._buffer_since
+                < cfg.dist.buffer_timeout_s):
             return
         buf, self._buffer = self._buffer, []
         t0 = time.time()
@@ -438,17 +551,27 @@ class PeerRuntime:
             (arrivals if out.get("ok") else rejected).append(out["rec"])
             if out.get("ok"):
                 weighted.append(out)
+        robust_info = None
         if weighted:
-            self._apply_merge(weighted)
+            if cfg.aggregator != "mean":
+                robust_info = self._apply_robust_merge(weighted)
+            else:
+                self._apply_merge(weighted)
         self.version += 1
-        self._note_version()
         rec = MergeRecord(
             version=self.version, leader=self.peer_id, arrivals=arrivals,
             rejected=rejected, wall_s=time.time() - t0,
             solo=self.gate.components() is not None,
             degraded=bool(down),
             quorum=({"component": len(comp), "alive": len(alive),
-                     "down": down} if down else None))
+                     "down": down, "quarantined": quarantined}
+                    if (down or quarantined) else None),
+            robust=robust_info,
+            # the precondition is stated over distinct peer VOTES (the
+            # rule's population), not buffered entries
+            robust_degraded=bool(
+                robust_info is not None
+                and robust_info.get("k", 0) < self._robust_min))
         self.merges.append(rec)
         # the FedBuff lineage event (OBSERVABILITY.md): which (peer,
         # msg_epoch, msg_id) updates, at what measured staleness and
@@ -459,11 +582,126 @@ class PeerRuntime:
             arrivals=rec.arrivals, rejected=rec.rejected, solo=rec.solo,
             degraded=rec.degraded, component=list(comp),
             quorum=rec.quorum, wall_s=rec.wall_s,
+            robust=rec.robust, robust_degraded=rec.robust_degraded,
             **({"chain_len": len(self.chain),
                 "head8": self.chain.head.hex()[:16], "rewrite": False}
                if self.chain is not None else {}))
+        if self.rep is not None:
+            # the merge IS the observation clock: fold the pending wire
+            # evidence (auth/outlier/staleness/replay + drained detector
+            # transitions) into the per-peer state machine, AFTER this
+            # merge's event (a quarantine this merge triggers must gate
+            # the NEXT merge, not retroactively taint this one), and
+            # commit any transitions to the chain BEFORE the broadcast so
+            # the suffix every follower adopts carries them.
+            self._drain_detector_evidence()
+            arrived = ([a["peer"] for a in arrivals]
+                       + [r["peer"] for r in rejected])
+            transitions = self.rep.observe_merge(arrived)
+            if transitions and self.chain is not None:
+                self.rep.commit_transitions(self.chain, self.version,
+                                            transitions)
+                telemetry.emit("ledger", op="rep_transition",
+                               n=len(transitions),
+                               chain_len=len(self.chain), rewrite=False,
+                               head8=self.chain.head.hex()[:16])
+        # history snapshot AFTER any reputation rows hit the chain: the
+        # broadcast ships the suffix INCLUDING those rows, so a follower's
+        # recorded head for this version is the post-rep-rows head — the
+        # leader's lineage record must match it, or every honest update
+        # based on this version would bounce as "fork lineage mismatch"
+        # (and feed the replay evidence lane!) after any transition
+        self._note_version()
         self._maybe_checkpoint()
         self._broadcast_global(healed=False)
+
+    def _drain_detector_evidence(self) -> None:
+        """Feed NEW failure-detector transitions to the reputation
+        tracker: a peer the circuit breaker drove to DOWN since the last
+        merge is unreliability evidence (the weakest lane — peer death is
+        not malice, but a flapping peer should not keep full merge
+        weight)."""
+        det = self.transport.detector
+        new = det.transitions_total - self._det_seen
+        if new <= 0:
+            return
+        self._det_seen = det.transitions_total
+        from bcfl_tpu.dist.transport import DOWN as _DOWN
+
+        recent = list(det.transitions)[-min(new, len(det.transitions)):]
+        for t in recent:
+            if t.get("to") == _DOWN:
+                self.rep.note_detector_down(t["peer"])
+
+    def _apply_robust_merge(self, updates: List[Dict]) -> Dict:
+        """Robust twin of :meth:`_apply_merge`: each buffered update is
+        collapsed to its client-slice delta (the weighted mean through the
+        same ``collapse`` program as the mean path), the deltas are
+        grouped into one vote PER SENDING PEER (``combine_votes`` — the
+        "f of k" breakdown arithmetic is over peers, so one sender's
+        message rate must never inflate its vote count), the votes are
+        aggregated host-side with the configured robust rule
+        (bcfl_tpu.dist.robust), and the global takes the same
+        ``async_server_lr`` × ``_async_merge_scale``-rescaled step along
+        the robust estimate — staleness shrinks the applied STEP, the
+        rule ignores it as a vote weight (the local robust contract,
+        ROBUSTNESS.md §2). Outlier flags land on every flagged peer's
+        arrival records and feed the reputation tracker."""
+        import jax
+        import jax.numpy as jnp
+
+        from bcfl_tpu.dist.robust import combine_votes, robust_merge
+        from bcfl_tpu.fed.engine import _tree_axpy
+
+        zero = jax.tree.map(jnp.zeros_like, self.trainable)
+        deltas_np, weights, base_total = [], [], 0.0
+        for u in updates:
+            w_dev = self.eng.mesh.shard_clients(jnp.asarray(u["alpha"]))
+            vote = self.eng.progs.collapse(u["deltas"], w_dev, zero)
+            deltas_np.append(jax.tree.map(np.asarray,
+                                          jax.device_get(vote)))
+            weights.append(float(np.asarray(u["alpha"]).sum()))
+            base_total += u["base_w"]
+        by_peer: Dict[int, List[int]] = {}
+        for i, u in enumerate(updates):
+            by_peer.setdefault(int(u["rec"]["peer"]), []).append(i)
+        peer_order = sorted(by_peer)
+        votes = [combine_votes([deltas_np[i] for i in by_peer[p]],
+                               [weights[i] for i in by_peer[p]])
+                 for p in peer_order]
+        vote_w = [sum(weights[i] for i in by_peer[p]) for p in peer_order]
+        agg, flags, info = robust_merge(
+            votes, vote_w, self.cfg.aggregator, self.cfg.aggregator_trim)
+        info["votes_by_peer"] = {str(p): len(by_peer[p])
+                                 for p in peer_order}
+        if "krum_selected" in info:
+            # robust_merge speaks in vote positions; the lineage record
+            # must name the PEER whose vote became the global (sender
+            # sets are rarely contiguous from 0 — a position would
+            # misattribute)
+            info["krum_selected_peer"] = peer_order[info["krum_selected"]]
+        dists = info.get("distances")
+        for j, p in enumerate(peer_order):
+            if not flags[j]:
+                continue
+            for i in by_peer[p]:
+                updates[i]["rec"]["outlier"] = True
+            # like every other evidence lane, never against self: under
+            # non-iid slices the leader's own honest vote can sit far
+            # from the aggregate, and a leader quarantining ITSELF while
+            # remaining the component leader would wedge the run (the
+            # flag still lands on the record for observability)
+            if self.rep is not None and p != self.peer_id:
+                self.rep.note_outlier(
+                    p, distance=(dists[j] if dists else None))
+        if agg is None:
+            return info  # every vote eliminated: params kept (degraded)
+        total = sum(weights)
+        scale = total / max(base_total, 1e-9)
+        agg_dev = self.eng.mesh.replicate(self._cast(agg))
+        self.trainable = _tree_axpy(self.trainable, agg_dev,
+                                    self.cfg.async_server_lr * scale)
+        return info
 
     def _prepare_update(self, header: Dict, trees: Dict, recv_t: float):
         """Commit + verify + decode one buffered update. Returns a record
@@ -471,12 +709,29 @@ class PeerRuntime:
         cfg = self.cfg
         src = int(header["from"])
         base_v = int(header["base_version"])
-        staleness = max(self.version - base_v, 0)
+        staleness, clamped = measured_staleness(self.version, base_v)
         rec = {"peer": src, "msg_id": header.get("msg_id"),
                "msg_epoch": header.get("msg_epoch"),
                "round": int(header["round"]),
                "base_version": base_v, "staleness": staleness,
                "latency_s": max(recv_t - float(header["sent_at"]), 0.0)}
+        if clamped:
+            # leader restarted onto an older version counter than this
+            # sender's base (see measured_staleness): the decay exponent
+            # is clamped — surfaced, never silently normalized
+            rec["staleness_clamped"] = True
+            telemetry.emit("warn", what="negative_staleness", peer_from=src,
+                           leader_version=int(self.version),
+                           base_version=base_v)
+        # post-ack quarantine gate, second seam (the first is _handle):
+        # an update BUFFERED before the quarantine transition must not
+        # merge after it — this check runs at merge time, which is what
+        # the no_quarantined_merge invariant holds the stream to
+        if (self.rep is not None and src != self.peer_id
+                and self.rep.is_quarantined(src)):
+            self.rep.quarantine_drops += 1
+            rec["rejected"] = "peer quarantined (post-ack gate)"
+            return {"ok": False, "rec": rec}
         # lineage check (BOTH wire formats) BEFORE anything touches the
         # chain: an update based on another fork's history must go through
         # the reconcile protocol, never a silent merge — and a protocol-
@@ -487,11 +742,17 @@ class PeerRuntime:
         hist = self.history.get(base_v)
         if hist is not None and hist[1] != header.get("lineage"):
             rec["rejected"] = "fork lineage mismatch"
+            if self.rep is not None and src != self.peer_id:
+                # the replay behavior's signature: a stale base's lineage
+                # resent against rewritten/advanced history
+                self.rep.note_replay(src, "fork lineage mismatch")
             return {"ok": False, "rec": rec}
         if self.eng._comp is None and hist is None:
             # uncompressed wire ships post-train params: the delta NEEDS
             # the base model, so an evicted base version is fatal here
             rec["rejected"] = "unknown base version"
+            if self.rep is not None and src != self.peer_id:
+                self.rep.note_replay(src, "unknown base version")
             return {"ok": False, "rec": rec}
         dev = self._to_device(trees["payload"])
         ids = [src * self.local_clients + c
@@ -516,6 +777,17 @@ class PeerRuntime:
                 if recomputed != header["digests"][c]:
                     auth[c] = 0.0
             rec["auth"] = auth.tolist()
+            if (self.rep is not None and src != self.peer_id
+                    and (auth == 0.0).any()):
+                # the hard evidence lane: announced one fingerprint,
+                # shipped another (digest forgery / equivocation / wire
+                # damage past the CRC — repetition tells them apart).
+                # Never against self (like every other lane): a leader
+                # configured as the adversary must not quarantine ITSELF
+                # while remaining leader — its forged self-update is
+                # already auth-masked out of the merge above
+                self.rep.note_auth_failure(
+                    src, float((auth == 0.0).mean()))
         if self.eng._comp is None:
             # uncompressed wire ships post-train params: reconstruct the
             # delta against the (lineage-verified, above) base model
@@ -530,12 +802,24 @@ class PeerRuntime:
                 rec["lineage_unverified"] = True
             deltas = self.eng.progs.decode_delta(
                 dev, self.eng.progs.broadcast(self.trainable))
+        if self.rep is not None and src != self.peer_id:
+            # measured-staleness evidence: a chronically stale peer (real
+            # slowness or deliberate replay) decays toward SUSPECT
+            self.rep.note_staleness(src, staleness)
         n_ex = np.asarray(header["n_ex"], np.float32)
         alpha = auth * (cfg.staleness_decay ** staleness)
         base_w = n_ex if cfg.weighted_agg else np.ones_like(n_ex)
         alpha = alpha * base_w
+        if self.rep is not None:
+            # trust gates merge weight: the EWMA score scales this
+            # update's whole vote (probation peers additionally carry the
+            # probation_weight fold) — the dist analogue of the engine's
+            # reputation-gate mask fold
+            trust_mult = self.rep.gate(src)
+            rec["trust"] = round(float(trust_mult), 6)
+            alpha = alpha * np.float32(trust_mult)
         if float(alpha.sum()) <= 0.0:
-            rec["rejected"] = "all clients eliminated (auth)"
+            rec["rejected"] = "all clients eliminated (auth/trust)"
             return {"ok": False, "rec": rec}
         # the update's total merge weight (staleness decay x examples x
         # auth, summed over the peer's client slice): part of the merge
@@ -783,6 +1067,11 @@ class PeerRuntime:
                 telemetry.emit("ledger", op="resync",
                                chain_len=len(self.chain), rewrite=True,
                                head8=self.chain.head.hex()[:16])
+                if self.rep is not None:
+                    # inherit the leader's committed reputation verdicts
+                    # from the adopted chain — a REJOINING peer re-enters
+                    # knowing who is quarantined instead of starting blind
+                    self.rep.absorb_rows(rows)
             elif (start == len(self.chain)
                   and self.chain.head.hex() == header.get("chain_prev_head")):
                 # contiguous suffix: verify incrementally as it lands
@@ -795,6 +1084,11 @@ class PeerRuntime:
                 telemetry.emit("ledger", op="append",
                                chain_len=len(self.chain), rewrite=False,
                                head8=self.chain.head.hex()[:16])
+                if self.rep is not None:
+                    # the suffix carries the leader's reputation rows too:
+                    # every follower tracks its leader's verdicts from the
+                    # broadcasts it already receives
+                    self.rep.absorb_rows(rows)
             else:
                 # gap or diverged base (missed broadcasts, fork rewrite):
                 # never adopt a model whose chain this replica can't
@@ -860,6 +1154,12 @@ class PeerRuntime:
             "ef_residual": (jax.device_get(self.eng._ef)
                             if self.eng._ef is not None else None),
         }
+        if self.rep is not None:
+            # the per-peer tracker rides the checkpoint bit-for-bit (the
+            # same rep_* keys as the engine's per-client lifecycle): a
+            # resumed leader re-enters with every trust score and
+            # quarantine timer exactly where the crash left them
+            state.update(self.rep.checkpoint_state())
         save_checkpoint(self.ckpt_dir, self.version, state,
                         self.chain.to_json()
                         if self.chain is not None else None)
@@ -898,6 +1198,24 @@ class PeerRuntime:
             self.chain = Ledger.from_json(ledger_json,
                                           self.cfg.ledger.use_native)
             self.eng.ledger = self.chain
+        if self.rep is not None and state.get("rep_trust") is not None:
+            self.rep.restore(state)
+            # the bit-identical-restore evidence: the EXACT restored
+            # arrays, recorded before anything evolves them, for the
+            # resume proof to compare against the checkpoint file
+            self._restored_rep = self.rep.report()
+            for p in self.rep.quarantined_peers():
+                # re-declare restored quarantines into THIS incarnation's
+                # stream: the no_quarantined_merge invariant is
+                # pid-scoped, so without this a resumed leader's
+                # post-restart merges would be judged against an empty
+                # quarantine set (the prior evidence in the same
+                # append-mode stream keeps quarantine_evidence satisfied)
+                telemetry.emit(
+                    "rep.transition", client=int(p), scope="peer",
+                    **{"from": "restored", "to": "quarantined",
+                       "trust": float(self.rep.tracker.trust[p])})
+        self._restored_from_version = int(state["version"])
         self.history = {
             self.version: (self.trainable if self.eng._comp is None
                            else None, self._head())}
@@ -911,6 +1229,15 @@ class PeerRuntime:
     def _handle(self, header: Dict, trees: Dict):
         kind = header.get("type")
         if kind == "update":
+            src = int(header.get("from", -1))
+            if (self.rep is not None and src != self.peer_id
+                    and self.rep.is_quarantined(src)):
+                # quarantine refusal is POST-ACK, like a partition-gate
+                # drop: the frame was delivered intact and the sender's
+                # failure detector must not read distrust as peer death
+                # (peer death != malice, and vice versa)
+                self.rep.quarantine_drops += 1
+                return
             if self._leader() == self.peer_id:
                 self._buffer_push((header, trees, time.time()))
             # an update addressed to a stale leader is dropped: the sender
@@ -1057,6 +1384,18 @@ class PeerRuntime:
             "dropped_by_gate": tstats["dropped_by_gate"],
             "fork": self.fork,
             "reconcile": self.reconcile,
+            # byzantine-tolerance surfaces (ROBUSTNESS.md §8): the
+            # per-peer tracker's state + the adversary's injection
+            # counters (exactly zero with the lane off — the baseline
+            # legs gate on these keys)
+            "reputation": (self.rep.report()
+                           if self.rep is not None else None),
+            "restored_reputation": getattr(self, "_restored_rep", None),
+            "restored_from_version": getattr(
+                self, "_restored_from_version", None),
+            "byzantine": (self.byz.stats() if self.byz is not None
+                          else {"armed": False, "injected": {},
+                                "total": 0}),
             "chain_len": len(self.chain) if self.chain is not None else None,
             "chain_head": self._head(),
             # verify_chain re-hashes the WHOLE ledger — O(chain) per call,

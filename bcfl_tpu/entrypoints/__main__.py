@@ -58,6 +58,13 @@ def main(argv=None):
     ap.add_argument("--dist-deadline", type=float, default=600.0,
                     help="hard per-peer wall deadline in seconds for "
                          "--runtime dist (a hung peer fails the run)")
+    ap.add_argument("--dist-buffer", type=int, default=None,
+                    metavar="N",
+                    help="FedBuff merge target for --runtime dist, in "
+                         "DISTINCT sending peers (0 = merge on every "
+                         "arrival, the pure-async default; must be <= "
+                         "peers). The robust --aggregator rules need "
+                         ">= 3 (krum: >= 2f+3) — RUNTIME.md §5")
     ap.add_argument("--dist-quorum", type=float, default=None,
                     metavar="FRAC",
                     help="quorum fraction for --runtime dist leaders: the "
@@ -261,6 +268,27 @@ def main(argv=None):
     ap.add_argument("--chaos-wire-rounds", default=None, metavar="START:END",
                     help="bound the wire lane to this half-open span of the "
                          "sender's local-round clock (default: every round)")
+    ap.add_argument("--chaos-byz", default=None, metavar="PEERS",
+                    help="byzantine lane for --runtime dist (ROBUSTNESS.md "
+                         "§8): comma-separated ADVERSARIAL peer ids — each "
+                         "rewrites its outbound updates above the wire "
+                         "(scaled/sign-flipped/garbage payloads, stale "
+                         "replays, digest forgeries, equivocation); caught "
+                         "by the robust --aggregator rules, the ledger "
+                         "refingerprint, and --reputation quarantine")
+    ap.add_argument("--chaos-byz-behaviors", default=None, metavar="LIST",
+                    help="comma subset of scale,sign_flip,garbage,replay,"
+                         "digest_forge,equivocate (default: all)")
+    ap.add_argument("--chaos-byz-prob", type=float, default=None,
+                    metavar="P", help="per-(peer, round) probability an "
+                    "adversarial peer acts (default 1.0)")
+    ap.add_argument("--chaos-byz-scale", type=float, default=None,
+                    metavar="S", help="payload perturbation magnitude for "
+                    "the scale/garbage behaviors (default 25.0)")
+    ap.add_argument("--chaos-byz-rounds", default=None, metavar="START:END",
+                    help="bound the byzantine lane to this half-open span "
+                         "of the adversary's local-round clock (default: "
+                         "every round)")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="seed of the chaos schedule (independent of --seed)")
     # peer-lifecycle reputation (bcfl_tpu.reputation, ROBUSTNESS.md §6)
@@ -407,7 +435,15 @@ def main(argv=None):
         or args.chaos_crash_round is not None
         or args.chaos_partition is not None
         or args.chaos_churn_leave or args.chaos_churn_join
-        or args.chaos_flaky is not None or args.chaos_wire is not None)
+        or args.chaos_flaky is not None or args.chaos_wire is not None
+        or args.chaos_byz is not None
+        # byz sub-flags enter the gate so "--chaos-byz-prob without
+        # --chaos-byz" reaches the fail-loudly check below instead of
+        # being silently ignored
+        or args.chaos_byz_behaviors is not None
+        or args.chaos_byz_prob is not None
+        or args.chaos_byz_scale is not None
+        or args.chaos_byz_rounds is not None)
     if chaos_flags:
         from bcfl_tpu.faults import FaultPlan
 
@@ -493,6 +529,42 @@ def main(argv=None):
                     f"--chaos-wire {args.chaos_wire!r} sets no "
                     "probability: add at least one of "
                     "drop/dup/reorder/delay/corrupt > 0")
+        if args.chaos_byz is not None:
+            try:
+                plan_kw["byz_peers"] = tuple(
+                    int(p) for p in args.chaos_byz.split(","))
+            except ValueError:
+                raise SystemExit(f"--chaos-byz {args.chaos_byz!r}: "
+                                 "expected comma-separated peer ids")
+            if args.chaos_byz_behaviors is not None:
+                plan_kw["byz_behaviors"] = tuple(
+                    b.strip() for b in args.chaos_byz_behaviors.split(",")
+                    if b.strip())
+            if args.chaos_byz_prob is not None:
+                plan_kw["byz_prob"] = args.chaos_byz_prob
+            if args.chaos_byz_scale is not None:
+                plan_kw["byz_scale"] = args.chaos_byz_scale
+            if args.chaos_byz_rounds is not None:
+                try:
+                    lo, hi = (int(x) for x in
+                              args.chaos_byz_rounds.split(":"))
+                except ValueError:
+                    raise SystemExit(f"--chaos-byz-rounds "
+                                     f"{args.chaos_byz_rounds!r}: "
+                                     "expected START:END")
+                if hi <= lo:
+                    raise SystemExit(f"--chaos-byz-rounds "
+                                     f"{args.chaos_byz_rounds!r}: empty "
+                                     "span (END must be > START; the span "
+                                     "is half-open)")
+                plan_kw["byz_rounds"] = tuple(range(lo, hi))
+        elif (args.chaos_byz_behaviors is not None
+              or args.chaos_byz_prob is not None
+              or args.chaos_byz_scale is not None
+              or args.chaos_byz_rounds is not None):
+            # same fail-loudly stance as the codec sub-flags
+            raise SystemExit("--chaos-byz-* tuning flags have no effect "
+                             "without --chaos-byz PEERS")
         if args.chaos_wire_rounds is not None:
             if args.chaos_wire is None:
                 raise SystemExit("--chaos-wire-rounds has no effect "
@@ -539,6 +611,8 @@ def main(argv=None):
         raise SystemExit("--peers only applies to --runtime dist")
     if args.dist_quorum is not None and args.runtime != "dist":
         raise SystemExit("--dist-quorum only applies to --runtime dist")
+    if args.dist_buffer is not None and args.runtime != "dist":
+        raise SystemExit("--dist-buffer only applies to --runtime dist")
     if args.runtime is not None:
         # runtime joins the ONE combined replace below: applying sync/mode/
         # faults first with runtime still "local" would run the local-
@@ -555,6 +629,8 @@ def main(argv=None):
                            peer_deadline_s=args.dist_deadline)
             if args.dist_quorum is not None:
                 dist_kw["quorum_frac"] = args.dist_quorum
+            if args.dist_buffer is not None:
+                dist_kw["buffer"] = args.dist_buffer
             overrides["dist"] = dataclasses.replace(cfg.dist, **dist_kw)
     cfg = cfg.replace(**overrides)
 

@@ -1,4 +1,5 @@
 from bcfl_tpu.faults.plan import (  # noqa: F401
+    BYZ_BEHAVIORS,
     FaultInjector,
     FaultPlan,
     SimulatedCrash,

@@ -55,7 +55,24 @@ into one seeded, deterministic, config-level schedule:
   real DCN actually exhibits — the lane the retry/dedup/CRC self-healing
   transport is validated against (``scripts/dist_chaos.py``). The local
   engine has no socket to inject at, so the capability table rejects the
-  lane on ``runtime="local"``.
+  lane on ``runtime="local"``,
+- **byzantine** — adversarial PEERS for the dist runtime
+  (``runtime="dist"`` only; ROBUSTNESS.md §8 "Adversary model"): the
+  ``byz_peers`` act maliciously per ``(peer, round)`` draw, injected
+  *above* the wire in :class:`bcfl_tpu.dist.byzantine.ByzantineAdversary`
+  — the frames are well-formed and correctly delivered (CRC passes, acks
+  flow); it is their CONTENT that lies. Behaviors: scaled / sign-flipped /
+  garbage update payloads (announced digests match, so ledger auth passes
+  and only the robust merge + outlier evidence catch them), replayed stale
+  updates (an old base version's payload resent verbatim), digest
+  forgeries (announce one fingerprint, ship another — the leader's
+  refingerprint-on-arrival catches it), and equivocation (different
+  payload bytes to different destinations under one announced digest).
+  Composable with the wire lane (a lying peer on a lossy network) and
+  bounded by ``byz_rounds``. The local engine exchanges no forgeable wire
+  headers, so the capability table rejects the lane on
+  ``runtime="local"`` (use ``corrupt_prob``/``flaky_*`` for the simulated
+  in-graph analogue).
 
 Everything is derived from ``(seed, fault lane, round)`` via
 ``np.random.default_rng`` — two engines with equal plans draw identical
@@ -93,6 +110,12 @@ _LANE_CORRUPT = 3
 _LANE_PARTITION = 4
 _LANE_FLAKY = 5
 _LANE_WIRE = 6
+_LANE_BYZ = 7
+
+# the byzantine lane's behavior vocabulary (ROBUSTNESS.md §8): every name a
+# plan may draw, in the canonical order the seeded choice indexes into
+BYZ_BEHAVIORS = ("scale", "sign_flip", "garbage", "replay", "digest_forge",
+                 "equivocate")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,6 +193,18 @@ class FaultPlan:
     wire_delay_s: float = 0.2
     wire_corrupt_prob: float = 0.0
     wire_rounds: Optional[Tuple[int, ...]] = None
+    # byzantine lane (runtime="dist" only): `byz_peers` are adversarial —
+    # each acts per (peer, round) with probability `byz_prob`, drawing one
+    # behavior from `byz_behaviors` (a subset of BYZ_BEHAVIORS; see
+    # `byz_action`). `byz_scale` is the payload perturbation magnitude for
+    # scale/garbage; `byz_rounds` bounds the lane to a span of the
+    # adversary's local-round clock (None = every round) — the knob the
+    # "recovers after the adversary goes quiet" legs use.
+    byz_peers: Optional[Tuple[int, ...]] = None
+    byz_behaviors: Tuple[str, ...] = BYZ_BEHAVIORS
+    byz_prob: float = 1.0
+    byz_scale: float = 25.0
+    byz_rounds: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         for name in ("dropout_prob", "straggler_prob", "corrupt_prob"):
@@ -297,6 +332,49 @@ class FaultPlan:
                 raise ValueError(
                     "wire_rounds without any wire_*_prob > 0 would "
                     "silently never inject a wire fault")
+        # --- byzantine lane ---
+        if self.byz_peers is not None:
+            if not (isinstance(self.byz_peers, tuple)
+                    and all(isinstance(p, int) and p >= 0
+                            for p in self.byz_peers)):
+                raise ValueError(
+                    "byz_peers must be a tuple of non-negative peer ids "
+                    "(hashable — the plan lives inside the frozen "
+                    "FedConfig)")
+            if len(set(self.byz_peers)) != len(self.byz_peers):
+                raise ValueError(
+                    f"byz_peers lists a peer twice: {self.byz_peers!r}")
+        if not (isinstance(self.byz_behaviors, tuple) and self.byz_behaviors):
+            raise ValueError("byz_behaviors must be a non-empty tuple")
+        bad = [b for b in self.byz_behaviors if b not in BYZ_BEHAVIORS]
+        if bad:
+            raise ValueError(
+                f"unknown byzantine behaviors {bad}; known: "
+                f"{BYZ_BEHAVIORS}")
+        if not 0.0 <= self.byz_prob <= 1.0:
+            raise ValueError(
+                f"byz_prob must be in [0, 1], got {self.byz_prob}")
+        if not np.isfinite(self.byz_scale):
+            raise ValueError("byz_scale must be finite (NaN/Inf would "
+                             "poison the very aggregates the robust merge "
+                             "is graded on tolerating)")
+        if self.byz_rounds is not None:
+            if not isinstance(self.byz_rounds, tuple):
+                raise ValueError("byz_rounds must be a tuple of round "
+                                 "indices (hashable — the plan lives "
+                                 "inside the frozen FedConfig)")
+            if not self.byz_rounds:
+                raise ValueError(
+                    "byz_rounds is empty: the byzantine lane would "
+                    "silently never fire (check the span bounds)")
+            if not self.byz_enabled:
+                raise ValueError(
+                    "byz_rounds without byz_peers would silently never "
+                    "inject an adversarial behavior")
+        if self.byz_peers is not None and self.byz_prob <= 0.0:
+            raise ValueError(
+                "byz_peers with byz_prob=0 would silently never act — "
+                "the exact vacuous-pass this lane must not have")
 
     # ------------------------------------------------------------------ query
 
@@ -305,13 +383,17 @@ class FaultPlan:
         return (self.dropout_prob > 0 or self.straggler_prob > 0
                 or self.corrupt_prob > 0 or self.crash_at_round is not None
                 or self.partitions or self.churns or self.flaky_enabled
-                or self.wire_enabled)
+                or self.wire_enabled or self.byz_enabled)
 
     @property
     def wire_enabled(self) -> bool:
         return (self.wire_drop_prob > 0 or self.wire_dup_prob > 0
                 or self.wire_reorder_prob > 0 or self.wire_delay_prob > 0
                 or self.wire_corrupt_prob > 0)
+
+    @property
+    def byz_enabled(self) -> bool:
+        return bool(self.byz_peers)
 
     @property
     def partitions(self) -> bool:
@@ -493,6 +575,42 @@ class FaultPlan:
                   attempt: int) -> np.random.Generator:
         return np.random.default_rng(
             (self.seed, _LANE_WIRE, rnd, src, dst, msg_id, attempt))
+
+    def byz_action(self, rnd: int, peer: int) -> Optional[dict]:
+        """Adversarial-behavior draw for ONE update of ``peer`` while its
+        local-round clock reads ``rnd`` (the same autonomous clock the
+        partition and wire lanes use). Returns None when the peer is
+        honest, the lane is off, the span is not due, or the ``byz_prob``
+        draw says "behave this round"; else::
+
+            {"behavior": <one of this plan's byz_behaviors>,
+             "scale": byz_scale}
+
+        Identical ``(seed, rnd, peer)`` coordinates always draw the
+        identical behavior — the injection is replayable, which is what
+        lets the unit tests pin per-behavior determinism and the chaos
+        legs assert exact evidence trails. Payload mutations draw their
+        noise separately via :meth:`byz_rng` keyed by the same coordinates
+        plus the destination (equivocation differs per destination BY
+        construction)."""
+        if not self.byz_enabled or peer not in self.byz_peers:
+            return None
+        if not self._due(self.byz_rounds, rnd):
+            return None
+        rng = np.random.default_rng((self.seed, _LANE_BYZ, rnd, peer))
+        if rng.random() >= self.byz_prob:
+            return None
+        pick = int(rng.integers(len(self.byz_behaviors)))
+        return {"behavior": self.byz_behaviors[pick],
+                "scale": float(self.byz_scale)}
+
+    def byz_rng(self, rnd: int, peer: int, dst: int) -> np.random.Generator:
+        """Noise stream for one (adversary, round, destination) payload
+        mutation — destination-keyed, so equivocation ships DIFFERENT
+        deterministic bytes to different receivers while the same
+        coordinates always replay the same bytes."""
+        return np.random.default_rng(
+            (self.seed, _LANE_BYZ, rnd, peer, dst, 1))
 
 
 class FaultInjector:

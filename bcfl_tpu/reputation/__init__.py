@@ -7,3 +7,7 @@ from bcfl_tpu.reputation.lifecycle import (  # noqa: F401
     ReputationConfig,
     ReputationTracker,
 )
+
+# NOTE: the dist-runtime peer tracker lives in bcfl_tpu.reputation.dist
+# (DistReputationTracker + the reserved ledger-row codec); it is imported
+# lazily by the dist runtime to keep this package import-light.

@@ -1,0 +1,294 @@
+"""Per-PEER reputation for the dist runtime — wire evidence in, quarantine
+out, committed to the ledger (ROBUSTNESS.md §8, RUNTIME.md §5).
+
+The PR 3 lifecycle (:class:`bcfl_tpu.reputation.lifecycle.ReputationTracker`)
+consumes a global per-round evidence view the local engine produces
+synchronously. The dist runtime has neither a global round nor a global
+view — but it produces BETTER evidence, on the wire, at every peer:
+
+- **ledger refingerprint mismatches** — the leader commits what a sender
+  ANNOUNCED and authenticates what ARRIVED; a mismatch is the hard
+  per-client evidence (``w_auth``) that catches digest forgery and
+  equivocation,
+- **robust-aggregator outlier flags** — the poisoning behaviors
+  (scaled/sign-flipped/garbage payloads under matching digests) pass auth
+  and are visible only as outliers of the buffered merge
+  (:func:`bcfl_tpu.dist.robust.robust_merge`; ``w_anomaly``),
+- **measured-staleness outliers and replay rejections** — an update whose
+  measured staleness exceeds ``staleness_limit``, or whose stale
+  base-version/lineage fails the merge's lineage check (``w_staleness``),
+- **the failure detector's transition log** — a peer the circuit breaker
+  keeps driving to DOWN is unreliable (``w_staleness``-weighted: peer
+  death is NOT malice — it can depress trust toward SUSPECT, and a dead
+  peer's quarantine costs nothing, but it is deliberately the weakest
+  lane).
+
+This module adapts that evidence onto the unchanged state machine: the
+same EWMA, thresholds, quarantine/probation timers, and telemetry — one
+index of the state vectors is a PEER, the observation clock is the
+leader's MERGE event (each merge advances the machine one step for the
+peers that participated or produced evidence), and the tracker is
+``scope="peer"`` so the collator can tell the two populations apart.
+
+Two dist-specific obligations live here too:
+
+- **Ledger commitment.** Every QUARANTINED/PROBATION/... transition is
+  appended to the chain as a reserved row (``client = REP_CLIENT_BASE -
+  peer``, a 32-byte structured snapshot in the digest slot — the chain
+  links hash it like any entry, so history is tamper-evident), and
+  :meth:`absorb_rows` replays such rows from any adopted chain segment: a
+  follower tracks its leader's verdicts from the broadcasts it already
+  receives, and a REJOINING peer inherits the quarantine state from the
+  HELLO resync chain instead of starting blind.
+- **Checkpointing.** :meth:`checkpoint_state`/:meth:`restore` ride the
+  peer checkpoint bit-for-bit (the same ``rep_*`` keys as the engine),
+  so a SIGKILLed leader resumes with every trust score and quarantine
+  timer exactly where the crash left them (``scripts/dist_byzantine.py``
+  gates this).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bcfl_tpu.reputation.lifecycle import (
+    QUARANTINED,
+    STATE_NAMES,
+    ReputationConfig,
+    ReputationTracker,
+)
+from bcfl_tpu.telemetry import events as _telemetry
+
+# reserved ledger-row client ids for reputation transitions: real clients
+# are >= 0 everywhere (global ids in dist), so rows at or below this base
+# can never collide with an update commitment. peer p's rows use
+# REP_CLIENT_BASE - p.
+REP_CLIENT_BASE = -1000
+
+# 32-byte structured "digest" of one transition snapshot: magic + peer +
+# state + timer + quarantine_events + trust (f64) + 4 pad. The chain head
+# hashes these bytes like any entry digest, so the snapshot is
+# tamper-evident without being a hash itself (it must DECODE — a rejoining
+# peer reconstructs state from it, not just verifies it).
+_ROW_FMT = "<4siiiid4x"
+_ROW_MAGIC = b"REPv"
+
+
+def rep_row_client(peer: int) -> int:
+    return REP_CLIENT_BASE - int(peer)
+
+
+def encode_rep_row(peer: int, state: int, timer: int, events: int,
+                   trust: float) -> bytes:
+    out = struct.pack(_ROW_FMT, _ROW_MAGIC, int(peer), int(state),
+                      int(timer), int(events), float(trust))
+    assert len(out) == 32
+    return out
+
+
+def decode_rep_row(client: int, digest: bytes) -> Optional[Dict]:
+    """The snapshot a reserved ledger row carries, or None for ordinary
+    rows (non-reserved client id or foreign digest bytes)."""
+    if client > REP_CLIENT_BASE or len(digest) != 32:
+        return None
+    magic, peer, state, timer, events, trust = struct.unpack(_ROW_FMT,
+                                                             digest)
+    if magic != _ROW_MAGIC or rep_row_client(peer) != client:
+        return None
+    if not 0 <= state < len(STATE_NAMES):
+        return None
+    return {"peer": int(peer), "state": int(state), "timer": int(timer),
+            "events": int(events), "trust": float(trust)}
+
+
+class DistReputationTracker:
+    """Peer-granularity reputation at one dist peer.
+
+    Evidence accrues between merges via the ``note_*`` methods (each emits
+    a ``rep.dist_evidence`` event naming its source); :meth:`observe_merge`
+    folds the pending evidence into the state machine — one observation
+    step per FedBuff merge, the dist analogue of the engine's per-round
+    ``observe``. Multiple evidence sources for one peer combine by max
+    (the same policy as the engine's evidence bridge)."""
+
+    # evidence source names (the `source` field of rep.dist_evidence)
+    SRC_AUTH = "ledger_auth"
+    SRC_OUTLIER = "robust_outlier"
+    SRC_STALENESS = "staleness"
+    SRC_REPLAY = "stale_replay"
+    SRC_DETECTOR = "detector_down"
+
+    def __init__(self, cfg: ReputationConfig, peers: int, self_id: int):
+        self.cfg = cfg
+        self.peers = int(peers)
+        self.self_id = int(self_id)
+        self.tracker = ReputationTracker(cfg, peers, scope="peer")
+        self._pending = np.zeros((self.peers,), np.float64)
+        self.quarantine_drops = 0  # post-ack refusals of quarantined arrivals
+
+    # ------------------------------------------------------------- evidence
+
+    def _note(self, peer: int, source: str, fault: float, **extra) -> None:
+        peer = int(peer)
+        if not 0 <= peer < self.peers or fault <= 0.0:
+            return
+        fault = min(float(fault), 1.0)
+        self._pending[peer] = max(self._pending[peer], fault)
+        _telemetry.emit("rep.dist_evidence", target=peer, source=source,
+                        fault=fault, **extra)
+
+    def note_auth_failure(self, peer: int, frac_failed: float) -> None:
+        """``frac_failed`` of the peer's client slice failed the leader's
+        refingerprint — digest forgery / equivocation / genuine wire
+        damage that slipped the CRC (the ledger lane is deliberately blind
+        to intent; repetition is what separates the three)."""
+        self._note(peer, self.SRC_AUTH, self.cfg.w_auth * frac_failed,
+                   frac_failed=float(frac_failed))
+
+    def note_outlier(self, peer: int, distance: Optional[float] = None
+                     ) -> None:
+        """The robust merge flagged this peer's update as an outlier of
+        the arrival cohort — the only lane that sees auth-passing
+        poison."""
+        self._note(peer, self.SRC_OUTLIER, self.cfg.w_anomaly,
+                   **({"distance": distance} if distance is not None
+                      else {}))
+
+    def note_staleness(self, peer: int, staleness: int) -> None:
+        lim = self.cfg.staleness_limit
+        if lim <= 0 or staleness <= lim:
+            return
+        self._note(peer, self.SRC_STALENESS, self.cfg.w_staleness,
+                   staleness=int(staleness))
+
+    def note_replay(self, peer: int, reason: str) -> None:
+        """A lineage-check rejection (stale base version / fork lineage
+        mismatch) — the replay behavior's signature."""
+        self._note(peer, self.SRC_REPLAY, self.cfg.w_staleness,
+                   reason=reason)
+
+    def note_detector_down(self, peer: int) -> None:
+        self._note(peer, self.SRC_DETECTOR, self.cfg.w_staleness)
+
+    # -------------------------------------------------------------- observe
+
+    def observe_merge(self, arrived: Sequence[int]
+                      ) -> List[Tuple[int, str, str]]:
+        """Advance the state machine one step (the merge IS the round).
+
+        ``arrived`` are the peers with an arrival in this merge (accepted
+        or rejected); peers with pending evidence but no arrival are
+        active too (a replayer whose update was rejected still offended).
+        Returns the transitions ``[(peer, from_name, to_name), ...]`` —
+        what the leader must commit to the ledger."""
+        active = np.zeros((self.peers,), bool)
+        for p in arrived:
+            if 0 <= int(p) < self.peers:
+                active[int(p)] = True
+        active |= self._pending > 0.0
+        before = self.tracker.state.copy()
+        self.tracker.observe(self._pending, active=active)
+        self._pending[:] = 0.0
+        out = []
+        for p in np.nonzero(self.tracker.state != before)[0]:
+            out.append((int(p), STATE_NAMES[int(before[p])],
+                        STATE_NAMES[int(self.tracker.state[p])]))
+        return out
+
+    # ---------------------------------------------------------------- gates
+
+    def gate(self, peer: int) -> float:
+        """Merge-weight multiplier for one peer's arrivals: 0.0
+        quarantined, ``probation_weight`` on probation, else the trust
+        score itself — trust continuously gates merge weight on the dist
+        path (the mean rule's analogue of the engine's mask fold; the
+        robust rules treat any positive weight as a full vote and rely on
+        quarantine for exclusion, same contract as the local module
+        note)."""
+        p = int(peer)
+        base = float(self.tracker.gate()[p])
+        if base == 0.0:
+            return 0.0
+        return base * float(np.clip(self.tracker.trust[p], 0.0, 1.0))
+
+    def is_quarantined(self, peer: int) -> bool:
+        return (0 <= int(peer) < self.peers
+                and int(self.tracker.state[int(peer)]) == QUARANTINED)
+
+    def quarantined_peers(self) -> List[int]:
+        return [int(p) for p in
+                np.nonzero(self.tracker.state == QUARANTINED)[0]]
+
+    # ------------------------------------------------------------ ledger I/O
+
+    def commit_transitions(self, ledger, version: int,
+                           transitions: List[Tuple[int, str, str]]) -> int:
+        """Append one reserved row per transition (leader side). Returns
+        how many rows were appended."""
+        if ledger is None or not transitions:
+            return 0
+        n = 0
+        for peer, _old, _new in transitions:
+            digest = encode_rep_row(
+                peer, int(self.tracker.state[peer]),
+                int(self.tracker.timer[peer]),
+                int(self.tracker.quarantine_events[peer]),
+                float(self.tracker.trust[peer]))
+            ledger.append_digest(int(version), rep_row_client(peer),
+                                 digest, 0)
+            n += 1
+        return n
+
+    def absorb_rows(self, rows) -> int:
+        """Replay reserved reputation rows from an adopted chain segment
+        (follower broadcast suffix, HELLO full resync, fork merge): each
+        decoded snapshot overwrites that peer's state/timer/trust — later
+        rows win, matching chain order. A peer's own row about ITSELF is
+        ignored (a leader's verdict on peer p arriving AT peer p still
+        applies — p learns it is quarantined — but self-rows can't
+        originate here anyway; symmetry is cheaper than the special case).
+        Returns how many rows applied."""
+        n = 0
+        for row in rows or ():
+            try:
+                client = int(row["client"])
+                digest = bytes.fromhex(row["digest"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            snap = decode_rep_row(client, digest)
+            if snap is None or not 0 <= snap["peer"] < self.peers:
+                continue
+            p = snap["peer"]
+            self.tracker.state[p] = snap["state"]
+            self.tracker.timer[p] = snap["timer"]
+            self.tracker.quarantine_events[p] = snap["events"]
+            self.tracker.trust[p] = snap["trust"]
+            n += 1
+        return n
+
+    # ------------------------------------------------------ checkpoint/report
+
+    def checkpoint_state(self) -> Dict[str, np.ndarray]:
+        return self.tracker.checkpoint_state()
+
+    def restore(self, state: Dict) -> None:
+        self.tracker.restore(state)
+
+    def report(self) -> Dict:
+        """Report block for report_peer*.json. Trust is serialized BOTH as
+        rounded floats (readability) and exact ``float.hex()`` strings —
+        the bit-identical-restore gate in scripts/dist_byzantine.py
+        compares the hex forms against the checkpoint's arrays."""
+        return {
+            "scope": "peer",
+            "state": self.tracker.state_names(),
+            "trust": [round(float(t), 6) for t in self.tracker.trust],
+            "trust_hex": [float(t).hex() for t in self.tracker.trust],
+            "timer": [int(t) for t in self.tracker.timer],
+            "quarantine_events": self.tracker.quarantine_events.tolist(),
+            "rounds_quarantined": self.tracker.rounds_quarantined.tolist(),
+            "quarantine_drops": int(self.quarantine_drops),
+        }

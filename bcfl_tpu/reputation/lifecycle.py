@@ -137,9 +137,17 @@ class ReputationTracker:
        is nothing to observe).
     """
 
-    def __init__(self, cfg: ReputationConfig, num_clients: int):
+    def __init__(self, cfg: ReputationConfig, num_clients: int,
+                 scope: str = "client"):
         self.cfg = cfg
         self.n = int(num_clients)
+        # what one index of the state vectors IS: "client" (the local
+        # engine's per-client lifecycle) or "peer" (the dist runtime's
+        # per-peer tracker, reputation/dist.py). Stamped onto every
+        # emitted rep.* event so the collator's invariants can tell the
+        # two populations apart (the `no_quarantined_merge` check judges
+        # only peer-scoped quarantines against merge lineage).
+        self.scope = str(scope)
         self.trust = np.ones((self.n,), np.float64)
         self.state = np.full((self.n,), HEALTHY, np.int64)
         self.timer = np.zeros((self.n,), np.int64)
@@ -187,7 +195,7 @@ class ReputationTracker:
             for c in np.nonzero(act & (fault > 0.0)
                                 & (self.state != QUARANTINED))[0]:
                 _telemetry.emit("rep.evidence", client=int(c),
-                                fault=float(fault[c]))
+                                fault=float(fault[c]), scope=self.scope)
         state_before = self.state.copy()
         for c in range(self.n):
             if self.state[c] == QUARANTINED:
@@ -224,7 +232,7 @@ class ReputationTracker:
         if _telemetry.get_writer() is not None:
             for c in np.nonzero(self.state != state_before)[0]:
                 _telemetry.emit(
-                    "rep.transition", client=int(c),
+                    "rep.transition", client=int(c), scope=self.scope,
                     **{"from": STATE_NAMES[int(state_before[c])],
                        "to": STATE_NAMES[int(self.state[c])],
                        "trust": float(self.trust[c])})

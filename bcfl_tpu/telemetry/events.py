@@ -81,6 +81,20 @@ EVENT_TYPES: Dict[str, tuple] = {
     # --- reputation lifecycle (bcfl_tpu.reputation) ---
     "rep.evidence": ("client", "fault"),
     "rep.transition": ("client", "from", "to", "trust"),
+    # dist wire-evidence lane (reputation/dist.py): which transport-level
+    # observation fed the peer tracker (source: ledger_auth |
+    # robust_outlier | staleness | stale_replay | detector_down). Never
+    # sampled — the quarantine proofs are queries over these.
+    "rep.dist_evidence": ("target", "source", "fault"),
+    # --- byzantine lane (bcfl_tpu.dist.byzantine) ---
+    # one adversarial injection: which behavior rewrote which outbound
+    # update (the baseline legs gate on the total being exactly zero)
+    "byz.inject": ("behavior",),
+    # --- anomalies worth surfacing that are not failures ---
+    # e.g. what="negative_staleness": a restarted leader's fresh version
+    # counter sat below a sender's base version; the merge clamps the
+    # decay exponent to 0 and records the raw value here
+    "warn": ("what",),
 }
 
 

@@ -34,6 +34,13 @@ rule consumes):
   declared rewrite (fork-merge adoption / full resync), which the emitting
   site flags ``rewrite: true``. A length decrease on a non-rewrite event
   is silent history loss.
+- **no_quarantined_merge** — a QUARANTINED peer's arrivals are refused
+  post-ack (RUNTIME.md §5); a merge whose lineage includes an arrival from
+  a peer that was quarantined AT THAT LEADER at merge time is the
+  byzantine-tolerance contract broken. Scoped by the leader's process
+  incarnation (peer, pid) and judged only against PEER-scoped
+  ``rep.transition`` events (the local engine's client-scoped lifecycle
+  shares the event types but talks about a different population).
 """
 
 from __future__ import annotations
@@ -207,6 +214,43 @@ def monotone_heads(events: List[Dict]) -> List[Dict]:
     return out
 
 
+def no_quarantined_merge(events: List[Dict]) -> List[Dict]:
+    # per leader incarnation (stream peer, pid): the set of peers the
+    # leader's own tracker currently holds QUARANTINED. Only peer-scoped
+    # transitions count — the engine's client-scoped lifecycle emits the
+    # same event types about clients, not peers. Stream order is the
+    # leader's own seq order (causal_order preserves per-stream chains),
+    # so "quarantined at merge time" is exactly "transition seen before
+    # the merge in this stream".
+    quarantined: Dict = {}  # (peer, pid) -> set of quarantined peer ids
+    out = []
+    for e in events:
+        key = (_peer_of(e), e.get("pid"))
+        ev = e.get("ev")
+        if ev == "rep.transition" and e.get("scope") == "peer":
+            q = quarantined.setdefault(key, set())
+            if e.get("to") == "quarantined":
+                q.add(e.get("client"))
+            else:
+                q.discard(e.get("client"))
+        elif ev == "merge":
+            q = quarantined.get(key)
+            if not q:
+                continue
+            for a in e.get("arrivals") or []:
+                if a.get("peer") in q:
+                    out.append({
+                        "rule": "no_quarantined_merge",
+                        "problem": "merged an arrival from a peer "
+                                   "quarantined at this leader",
+                        "leader": key[0], "leader_pid": key[1],
+                        "version": e.get("version"),
+                        "from_peer": a.get("peer"),
+                        "arrival": a,
+                    })
+    return out
+
+
 # name -> (check fn, one-line description); the collator and the trace CLI
 # walk this registry — adding a rule here adds it to every consumer
 INVARIANTS = {
@@ -226,6 +270,10 @@ INVARIANTS = {
     "monotone_heads": (
         monotone_heads,
         "per-peer ledger length is monotone outside declared rewrites"),
+    "no_quarantined_merge": (
+        no_quarantined_merge,
+        "no merge lineage includes an arrival from a peer quarantined at "
+        "that leader (per incarnation)"),
 }
 
 

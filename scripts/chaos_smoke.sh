@@ -159,6 +159,26 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# Byzantine leg (ROBUSTNESS.md §8 "Adversary model"): 2 honest peers + 1
+# adversarial peer that poisons (scaled payloads under re-announced
+# digests) and forges (announce one fingerprint, ship another) its
+# updates ABOVE the wire. The robust buffered merge (trimmed_mean over
+# per-peer votes) plus the wire-evidence reputation tracker must
+# quarantine it within the evidence budget, refuse its arrivals post-ack
+# (zero no_quarantined_merge violations), and keep the final loss at the
+# adversary-free twin's level — gates adapted to the armed behaviors by
+# the script itself. The full proof (plus the leader-SIGKILL +
+# bit-identical tracker restore leg) is scripts/dist_byzantine.py with
+# its default legs.
+echo
+echo "byzantine leg: 2 honest + 1 adversarial peer, trimmed_mean + reputation"
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/dist_byzantine.py --peers 3 --rounds 6 \
+    --legs byzantine,baseline --deadline 400 --idle-timeout 90 \
+    --out /tmp/bcfl_chaos_dist_byzantine.json
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Collator leg (OBSERVABILITY.md): re-run `bcfl-tpu trace` standalone over
 # the wire-chaos run's per-peer event streams — merges them into one
 # causally-ordered timeline and FAILS on any delivery-contract invariant

@@ -1,0 +1,470 @@
+"""Byzantine-tolerance proof driver: a lying peer on a real wire
+(ROBUSTNESS.md §8 "Adversary model", RUNTIME.md §5).
+
+Runs the multi-process dist runtime on CPU loopback with ONE seeded
+adversarial peer (FaultPlan byzantine lane) under the robust buffered
+merge + wire-evidence reputation quarantine, and writes
+``results/dist_byzantine.json`` with hard pass/fail gates:
+
+**byzantine** — 3 peers, peer 2 adversarial (``scale`` poisoning +
+``digest_forge`` forgeries at prob 1.0) under ``trimmed_mean`` +
+reputation + ledger. Gates: the run completes; the adversary reaches
+QUARANTINED at the leader within the evidence budget (and every follower
+holds the same verdict, inherited from the broadcast chain rows); the
+collator reports ZERO ``no_quarantined_merge`` violations (no merge
+lineage includes a post-quarantine arrival) and zero violations across
+the whole invariant suite; post-ack quarantine refusals actually fired;
+the final consensus head verifies end to end on every replica (one head,
+chains OK); and the final loss is within ``--loss-rtol`` of the
+adversary-free twin.
+
+**baseline** — the SAME config and seed with the byzantine lane off.
+Gates: clean completion with the byzantine counters EXACTLY zero at
+every peer, zero quarantine events (the machinery is gated precisely by
+its knobs — PR 8/9 behavior reproduced), zero invariant violations, and
+chains verified. Its final loss is the twin the byzantine leg's
+tolerance gate compares against.
+
+**resume** — the byzantine leg re-run with the QUARANTINING LEADER
+(peer 0) SIGKILLed once its checkpoint passes ``--kill-after-version``
+(after quarantine has committed) and restarted with ``--resume``. Gates:
+the restarted leader restores the reputation tracker BIT-IDENTICALLY
+(the report's restored ``trust_hex``/state/timer arrays equal the ones
+read straight out of the durable checkpoint file), the quarantined
+adversary is NOT re-admitted (still quarantined at the end, zero
+``no_quarantined_merge`` violations across both leader incarnations),
+and the run still completes with verified chains.
+
+Quarantine math (defaults): the ``digest_forge`` rounds fail the
+leader's refingerprint wholesale (fault 1.0 via ``w_auth``), so trust
+walks 1.0 -> 0.6 -> 0.36 < 0.4 within ~2 offending merges — the
+evidence budget ``--quarantine-by`` (default: half the target versions)
+is generous. ``quarantine_rounds`` is set longer than any run so
+readmission cannot blur the no-re-admission gates.
+
+Usage: python scripts/dist_byzantine.py [--peers 3] [--rounds 8]
+           [--legs byzantine,baseline,resume] [--deadline 600]
+           [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+ADVERSARY_BEHAVIORS = ("scale", "digest_forge")
+
+
+def build_cfg(args, byzantine: bool):
+    from bcfl_tpu.config import (
+        DistConfig,
+        FedConfig,
+        LedgerConfig,
+        PartitionConfig,
+    )
+    from bcfl_tpu.faults import FaultPlan
+    from bcfl_tpu.reputation import ReputationConfig
+
+    adversary = args.peers - 1  # highest id: never a component leader
+    plan = FaultPlan()
+    if byzantine:
+        plan = FaultPlan(seed=args.chaos_seed, byz_peers=(adversary,),
+                         byz_prob=1.0, byz_behaviors=ADVERSARY_BEHAVIORS,
+                         byz_scale=args.byz_scale)
+    return FedConfig(
+        name="dist_byzantine", runtime="dist", mode="server", sync="async",
+        model=args.model, dataset="synthetic",
+        num_clients=args.clients, num_rounds=args.rounds,
+        seq_len=args.seq_len, batch_size=args.batch_size,
+        max_local_batches=2, eval_every=0, seed=args.seed,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        aggregator="trimmed_mean",
+        reputation=ReputationConfig(
+            enabled=True,
+            # longer than any run: a quarantined adversary is never
+            # readmitted, so "no re-admission" is a hard gate, not a
+            # race against the probation timer
+            quarantine_rounds=100_000),
+        faults=plan,
+        dist=DistConfig(
+            peers=args.peers, buffer=args.peers,  # every merge wants all
+            buffer_timeout_s=args.buffer_timeout,
+            idle_timeout_s=args.idle_timeout,
+            peer_deadline_s=args.deadline,
+            checkpoint_every_versions=1),
+        checkpoint_dir=None,
+    )
+
+
+def _collate(result):
+    from bcfl_tpu.telemetry import collate
+
+    return collate(result["event_streams"])
+
+
+def _quarantine_version(ordered, adversary: int):
+    """The model version at which the leader's tracker quarantined the
+    adversary — the evidence-budget measurement. The transition event is
+    emitted inside ``observe_merge`` right after its merge event, so the
+    verdict's version is the last merge version preceding the first
+    peer-scoped ``rep.transition -> quarantined`` in the leader's own
+    stream (restore re-declarations from a later incarnation carry
+    ``from: "restored"`` and are not the original verdict)."""
+    last_merge_v = None
+    for e in ordered:
+        if e.get("peer") != 0:
+            continue
+        if e.get("ev") == "merge":
+            last_merge_v = e.get("version")
+        elif (e.get("ev") == "rep.transition"
+              and e.get("scope") == "peer"
+              and e.get("client") == adversary
+              and e.get("to") == "quarantined"
+              and e.get("from") != "restored"):
+            return last_merge_v
+    return None
+
+
+def _consensus(reports) -> dict:
+    heads = {p: r.get("chain_head") for p, r in reports.items()}
+    return {
+        "heads": heads,
+        "one_head": len(set(heads.values())) == 1,
+        "chains_ok": bool(reports) and all(
+            r.get("chain_ok") in (True, None) for r in reports.values()),
+    }
+
+
+def _quarantine_record(reports, adversary: int, ordered) -> dict:
+    """Where each peer's tracker landed on the adversary, the version of
+    the quarantine verdict (from the leader's event stream — the
+    evidence-budget measurement), and the first merge that gated the
+    adversary out of its target."""
+    leader = reports.get(0, {})
+    states = {p: ((r.get("reputation") or {}).get("state") or [None])
+              for p, r in reports.items()}
+    first_gated = None
+    for m in leader.get("merges") or []:
+        q = (m.get("quorum") or {}).get("quarantined") or []
+        if adversary in q:
+            first_gated = m["version"]
+            break
+    return {
+        "adversary": adversary,
+        "state_per_peer": {p: (s[adversary] if len(s) > adversary else None)
+                           for p, s in states.items()},
+        "leader_trust": (leader.get("reputation") or {}).get("trust"),
+        "quarantine_drops": {
+            p: (r.get("reputation") or {}).get("quarantine_drops")
+            for p, r in reports.items()},
+        "quarantined_at_version": _quarantine_version(ordered, adversary),
+        "first_gated_merge_version": first_gated,
+    }
+
+
+def run_byzantine_leg(args, kill_leader: bool = False) -> dict:
+    from bcfl_tpu.dist.harness import run_dist
+
+    adversary = args.peers - 1
+    cfg = build_cfg(args, byzantine=True)
+    tag = "resume" if kill_leader else "byz"
+    run_dir = os.path.join("/tmp", f"bcfl_dist_byz_{tag}_{os.getpid()}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    kw = {}
+    if kill_leader:
+        # SIGKILL the QUARANTINING leader once its durable checkpoint has
+        # reached --kill-after-version (past the ~2-merge quarantine
+        # walk), restart with --resume: the tracker must come back
+        # bit-for-bit and the adversary must stay locked out
+        kw = dict(kill_peer=0, kill_after_version=args.kill_after_version,
+                  restart_killed=True)
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform, **kw)
+    reports = result["reports"]
+    col = _collate(result)
+    ordered = col.pop("ordered")
+    leader = reports.get(0, {})
+    cons = _consensus(reports)
+    quar = _quarantine_record(reports, adversary, ordered)
+    byz_counts = {p: (r.get("byzantine") or {}).get("total", 0)
+                  for p, r in reports.items()}
+    gates = {
+        "completed_within_deadline": (
+            result["ok"] and len(reports) == args.peers),
+        # the adversary actually injected, and ONLY the adversary
+        "adversary_injected": byz_counts.get(adversary, 0) > 0,
+        "honest_peers_injected_nothing": all(
+            byz_counts.get(p, 0) == 0 for p in range(args.peers)
+            if p != adversary),
+        # QUARANTINED at the leader within the evidence budget: the
+        # verdict's merge version (read from the leader's event stream)
+        # at or before --quarantine-by
+        "adversary_quarantined_at_leader": (
+            quar["state_per_peer"].get(0) == "quarantined"),
+        "quarantined_within_budget": (
+            quar["quarantined_at_version"] is not None
+            and quar["quarantined_at_version"] <= args.quarantine_by),
+        # every follower inherited the verdict from the broadcast rows
+        "followers_inherited_quarantine": all(
+            quar["state_per_peer"].get(p) == "quarantined"
+            for p in range(1, args.peers)),
+        "post_ack_refusals_fired": (
+            (quar["quarantine_drops"].get(0) or 0) > 0),
+        # the tentpole invariant: zero merges whose lineage includes a
+        # post-quarantine arrival — plus the whole PR 8/9 contract suite
+        "zero_no_quarantined_merge": (
+            col["invariants"].get("no_quarantined_merge") == 0),
+        "zero_invariant_violations": col["ok"],
+        "consensus_head_verifies": cons["one_head"] and cons["chains_ok"],
+    }
+    if kill_leader:
+        gates.update(_resume_gates(result, cfg, leader, adversary, col))
+    return {
+        "leg": "resume" if kill_leader else "byzantine",
+        "run_dir": run_dir,
+        "adversary": adversary,
+        "behaviors": list(ADVERSARY_BEHAVIORS),
+        "byz_injections": byz_counts,
+        "adversary_injected_by_behavior": (
+            (reports.get(adversary, {}).get("byzantine") or {})
+            .get("injected")),
+        "quarantine": quar,
+        "consensus": cons,
+        "kill": result.get("kill"),
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "final_eval": leader.get("final_eval"),
+        "invariants": col["invariants"],
+        "invariant_violations": col["violations"],
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+        "log_tails": None if all(gates.values()) else result["log_tails"],
+    }
+
+
+def _resume_gates(result, cfg, leader: dict, adversary: int,
+                  col: dict) -> dict:
+    """The SIGKILL + --resume leg's extra gates: bit-identical tracker
+    restore (report vs the durable checkpoint file, compared in
+    ``float.hex()`` form) and no re-admission of the quarantined peer."""
+    from bcfl_tpu.checkpoint import restore_checkpoint
+    from bcfl_tpu.reputation.lifecycle import STATE_NAMES
+
+    gates = {
+        "leader_killed_and_resumed": (
+            result.get("kill") is not None and result["kill"]["restarted"]
+            and leader.get("resumed") is True
+            and leader.get("status") == "ok"),
+    }
+    restored = leader.get("restored_reputation")
+    from_version = leader.get("restored_from_version")
+    gates["restore_recorded"] = bool(restored) and from_version is not None
+    bit_identical = False
+    if gates["restore_recorded"]:
+        ckpt_dir = os.path.join(result["run_dir"], "ckpt_peer0")
+        disk = restore_checkpoint(ckpt_dir, int(from_version))
+        if disk is not None:
+            state, _ledger = disk
+            bit_identical = (
+                restored["trust_hex"] == [
+                    float(t).hex() for t in state["rep_trust"]]
+                and restored["state"] == [
+                    STATE_NAMES[int(s)] for s in state["rep_state"]]
+                and restored["timer"] == [int(t) for t in
+                                          state["rep_timer"]]
+                and restored["quarantine_events"] == [
+                    int(x) for x in state["rep_quarantine_events"]])
+    gates["tracker_restored_bit_identical"] = bit_identical
+    # no re-admission: restored ALREADY quarantined, still quarantined at
+    # the end, and (via zero_no_quarantined_merge, checked by the caller)
+    # no post-restart merge ever included the adversary
+    gates["no_readmission_of_quarantined_peer"] = (
+        bool(restored)
+        and restored["state"][adversary] == "quarantined"
+        and ((leader.get("reputation") or {}).get("state")
+             or [None] * cfg.dist.peers)[adversary] == "quarantined")
+    return gates
+
+
+def run_baseline_leg(args) -> dict:
+    from bcfl_tpu.dist.harness import run_dist
+
+    cfg = build_cfg(args, byzantine=False)
+    run_dir = os.path.join("/tmp", f"bcfl_dist_byz_base_{os.getpid()}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform)
+    reports = result["reports"]
+    col = _collate(result)
+    cons = _consensus(reports)
+    byz_counts = {p: (r.get("byzantine") or {}).get("total", 0)
+                  for p, r in reports.items()}
+    quarantine_events = sum(
+        sum((r.get("reputation") or {}).get("quarantine_events") or [])
+        for r in reports.values())
+    gates = {
+        "completed_within_deadline": (
+            result["ok"] and len(reports) == args.peers),
+        # the lane is gated precisely by its knobs: with it off, the
+        # injection counters are EXACTLY zero everywhere and nobody was
+        # ever quarantined — PR 8/9 clean behavior reproduced
+        "byzantine_counters_exactly_zero": all(
+            v == 0 for v in byz_counts.values()),
+        "zero_quarantine_events": quarantine_events == 0,
+        "zero_invariant_violations": col["ok"],
+        "consensus_head_verifies": cons["one_head"] and cons["chains_ok"],
+    }
+    return {
+        "leg": "baseline", "run_dir": run_dir,
+        "byz_injections": byz_counts,
+        "quarantine_events_total": quarantine_events,
+        "consensus": cons,
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "final_eval": reports.get(0, {}).get("final_eval"),
+        "invariants": col["invariants"],
+        "invariant_violations": col["violations"],
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+        "log_tails": None if all(gates.values()) else result["log_tails"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=3,
+                    help="peer processes; the highest id is the adversary")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="default: 2 per peer")
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="global model versions the leader must produce")
+    ap.add_argument("--model", default="tiny-bert")
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--chaos-seed", type=int, default=11,
+                    help="byzantine-lane seed. The default's draw opens "
+                         "with two digest_forge rounds, so the default "
+                         "reputation thresholds quarantine within ~2 "
+                         "merges — the behavior SEQUENCE is deterministic "
+                         "per seed (FaultPlan.byz_action); only merge "
+                         "composition varies run to run")
+    ap.add_argument("--byz-scale", type=float, default=25.0)
+    ap.add_argument("--quarantine-by", type=int, default=None,
+                    help="evidence budget: the adversary must be gated "
+                         "out of a merge at or before this version "
+                         "(default: half the target versions)")
+    ap.add_argument("--kill-after-version", type=int, default=5,
+                    help="resume leg: SIGKILL the leader once its durable "
+                         "checkpoint reaches this version (must sit past "
+                         "the ~2-merge quarantine walk)")
+    ap.add_argument("--loss-rtol", type=float, default=0.35,
+                    help="relative tolerance of the byzantine leg's final "
+                         "loss vs the adversary-free twin (two real "
+                         "concurrent runs differ by merge composition, "
+                         "not only by the adversary)")
+    ap.add_argument("--legs", default="byzantine,baseline,resume",
+                    help="comma subset of byzantine,baseline,resume (the "
+                         "loss-tolerance gate needs both byzantine and "
+                         "baseline)")
+    ap.add_argument("--buffer-timeout", type=float, default=8.0)
+    ap.add_argument("--deadline", type=float, default=600.0)
+    ap.add_argument("--idle-timeout", type=float, default=120.0)
+    ap.add_argument("--platform", default=os.environ.get("JAX_PLATFORMS")
+                    or "cpu")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT, "results",
+                                                  "dist_byzantine.json"))
+    args = ap.parse_args(argv)
+    if args.clients is None:
+        args.clients = 2 * args.peers
+    if args.quarantine_by is None:
+        args.quarantine_by = max(args.rounds // 2, 3)
+    if args.peers < 3:
+        print("need >= 3 peers: trimmed_mean's arrival population must "
+              "hold an honest majority around one adversary",
+              file=sys.stderr)
+        return 2
+    legs = [s.strip() for s in args.legs.split(",") if s.strip()]
+    bad = [s for s in legs if s not in ("byzantine", "baseline", "resume")]
+    if bad:
+        print(f"unknown legs {bad}", file=sys.stderr)
+        return 2
+
+    record = {"proof": "dist_byzantine", "peers": args.peers,
+              "clients": args.clients, "target_versions": args.rounds,
+              "adversary": args.peers - 1,
+              "behaviors": list(ADVERSARY_BEHAVIORS),
+              "aggregator": "trimmed_mean",
+              "quarantine_budget_versions": args.quarantine_by,
+              "legs": {}}
+    t0 = time.time()
+    for leg in legs:
+        print(f"dist_byzantine: running leg '{leg}' ({args.peers} peers, "
+              f"adversary peer {args.peers - 1}, target {args.rounds} "
+              f"versions)", flush=True)
+        if leg == "byzantine":
+            out = run_byzantine_leg(args)
+        elif leg == "baseline":
+            out = run_baseline_leg(args)
+        else:
+            out = run_byzantine_leg(args, kill_leader=True)
+        record["legs"][leg] = out
+        print(json.dumps({"leg": leg, "gates": out["gates"],
+                          "wall_s": out["wall_s"]}, indent=2), flush=True)
+
+    # the loss-tolerance gate spans two legs: the byzantine run's final
+    # loss vs its adversary-free twin (trimmed_mean + quarantine must
+    # keep the poison OUT of the model, not merely flag it)
+    byz = record["legs"].get("byzantine")
+    base = record["legs"].get("baseline")
+    if byz and base:
+        l_byz = (byz.get("final_eval") or {}).get("loss")
+        l_base = (base.get("final_eval") or {}).get("loss")
+        ok = (l_byz is not None and l_base is not None
+              and abs(l_byz - l_base) <= args.loss_rtol
+              * max(abs(l_base), 1e-9))
+        record["loss_tolerance"] = {
+            "byzantine_loss": l_byz, "baseline_loss": l_base,
+            "rtol": args.loss_rtol,
+            "rel_delta": (abs(l_byz - l_base) / max(abs(l_base), 1e-9)
+                          if l_byz is not None and l_base is not None
+                          else None),
+        }
+        byz["gates"]["loss_within_tolerance_of_twin"] = ok
+        byz["ok"] = all(byz["gates"].values())
+
+    record["ok"] = all(leg["ok"] for leg in record["legs"].values())
+    record["wall_s"] = time.time() - t0
+    record["recorded_at"] = int(time.time())
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    if not record["ok"]:
+        for name, leg in record["legs"].items():
+            for p, tail in (leg.get("log_tails") or {}).items():
+                print(f"--- {name} peer {p} log tail ---\n{tail}",
+                      flush=True)
+        print(f"dist_byzantine FAILED (evidence in {args.out})", flush=True)
+        return 1
+    print(f"dist_byzantine OK in {record['wall_s']:.1f}s -> {args.out}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

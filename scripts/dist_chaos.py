@@ -46,6 +46,15 @@ baseline wall: the measured telemetry overhead fraction, recorded into
 the results artifact (acceptance budget: <5% on a quiet host; the gate
 itself is looser because two dist runs differ by real concurrency).
 
+**byzantine** — the wire lane COMPOSED with the FaultPlan byzantine lane
+(ROBUSTNESS.md §8): the highest peer poisons and forges its updates
+above a socket that drops/dups/reorders beneath everyone, under
+trimmed_mean + reputation. Gates: completion, both lanes' counters
+nonzero, the leader's tracker distrusts the adversary, zero invariant
+violations (incl. ``no_quarantined_merge``), chains verified. The full
+single-lane adversary proof (quarantine budget, loss tolerance, leader
+SIGKILL + bit-identical tracker restore) is ``scripts/dist_byzantine.py``.
+
 Wire faults are drawn from ``(seed, lane, round, src, dst, msg_id,
 attempt)`` — deterministic per message coordinate, but the realized
 message sequence depends on real concurrency, so the wire leg's fault
@@ -71,20 +80,38 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 
-def build_cfg(args, wire: bool, chaos_seed: int, buffer: int = 0):
+def build_cfg(args, wire: bool, chaos_seed: int, buffer: int = 0,
+              byzantine: bool = False):
     from bcfl_tpu.config import DistConfig, FedConfig, LedgerConfig, PartitionConfig
     from bcfl_tpu.faults import FaultPlan
+    from bcfl_tpu.reputation import ReputationConfig
 
-    plan = FaultPlan()
+    plan_kw = {}
     if wire:
-        plan = FaultPlan(
-            seed=chaos_seed,
+        plan_kw.update(
             wire_drop_prob=args.wire_drop, wire_dup_prob=args.wire_dup,
             wire_reorder_prob=args.wire_reorder,
             wire_reorder_hold_s=0.2,
             wire_delay_prob=args.wire_delay, wire_delay_s=0.1,
             wire_corrupt_prob=args.wire_corrupt)
+    if byzantine:
+        # a lying peer ON a lossy network: the lanes must compose (the
+        # adversary's forgeries ride frames the wire lane is free to
+        # drop/dup/corrupt; evidence still accrues from what arrives)
+        plan_kw.update(byz_peers=(args.peers - 1,), byz_prob=1.0,
+                       byz_behaviors=("scale", "digest_forge"))
+    plan = (FaultPlan(seed=chaos_seed, **plan_kw) if plan_kw
+            else FaultPlan())
+    extra = {}
+    if byzantine:
+        # the byzantine leg grades the defense, so it arms it: robust
+        # buffered merge + per-peer wire-evidence reputation
+        extra = dict(
+            aggregator="trimmed_mean",
+            reputation=ReputationConfig(enabled=True,
+                                        quarantine_rounds=100_000))
     return FedConfig(
+        **extra,
         name="dist_chaos", runtime="dist", mode="server", sync="async",
         model=args.model, dataset="synthetic",
         num_clients=args.clients, num_rounds=args.rounds,
@@ -179,6 +206,74 @@ def run_wire_leg(args, chaos_seed: int) -> dict:
             _tsum(reports, "reorders_held") > 0)
     return {
         "leg": "wire", "chaos_seed": chaos_seed, "run_dir": run_dir,
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "timeline": _timeline_block(col),
+        "invariants": col["invariants"],
+        "invariant_violations": col["violations"],
+        "transport": {p: rep.get("transport")
+                      for p, rep in reports.items()},
+        "returncodes": result["returncodes"],
+        "wall_s": result["wall_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+        "log_tails": None if all(gates.values()) else result["log_tails"],
+    }
+
+
+def run_byzantine_leg(args, chaos_seed: int) -> dict:
+    """Wire + byzantine COMPOSED (the full proof of each lane alone is
+    scripts/dist_byzantine.py / the wire leg here): the highest peer lies
+    above a socket that drops/dups/reorders beneath everyone. Gates: the
+    run completes; both lanes' counters are nonzero (the adversary
+    injected AND the transport healed real wire faults); the leader's
+    tracker distrusts the adversary (quarantined, or trust below the
+    suspect threshold — under frame drop the evidence stream thins, so
+    full quarantine timing is not guaranteed, distrust is); zero
+    violations across the invariant suite (incl. no_quarantined_merge);
+    chains verify."""
+    from bcfl_tpu.dist.harness import run_dist
+
+    adversary = args.peers - 1
+    # buffer = peers: trimmed_mean's precondition (>= 3 distinct votes)
+    cfg = build_cfg(args, wire=True, chaos_seed=chaos_seed,
+                    buffer=args.peers, byzantine=True)
+    run_dir = os.path.join("/tmp", f"bcfl_dist_chaos_byz_{os.getpid()}_"
+                                   f"{chaos_seed}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    result = run_dist(cfg, run_dir, deadline_s=args.deadline,
+                      platform=args.platform)
+    reports = result["reports"]
+    col = _collate(result)
+    merges = col["timeline"]["merges"]
+    leader_rep = (reports.get(0, {}).get("reputation") or {})
+    adv_state = (leader_rep.get("state") or [None] * args.peers)[adversary]
+    adv_trust = (leader_rep.get("trust") or [1.0] * args.peers)[adversary]
+    byz_total = (reports.get(adversary, {}).get("byzantine")
+                 or {}).get("total", 0)
+    gates = {
+        "completed_within_deadline": (
+            result["ok"] and len(reports) == args.peers),
+        "zero_invariant_violations": col["ok"],
+        "merges_recorded": merges["count"] > 0 and merges["arrivals"] > 0,
+        "byz_injections_nonzero": byz_total > 0,
+        "wire_faults_healed_nonzero": (
+            _tsum(reports, "retries") > 0
+            and _tsum(reports, "dups_dropped") > 0),
+        "adversary_distrusted": (
+            adv_state == "quarantined"
+            or (adv_trust is not None and adv_trust < 0.7)),
+        "chains_verify": bool(reports) and all(
+            rep.get("chain_ok") in (True, None)
+            for rep in reports.values()),
+    }
+    return {
+        "leg": "byzantine", "chaos_seed": chaos_seed, "run_dir": run_dir,
+        "adversary": adversary,
+        "adversary_state_at_leader": adv_state,
+        "adversary_trust_at_leader": adv_trust,
+        "byz_injections": byz_total,
         "final_versions": {p: r.get("final_version")
                            for p, r in reports.items()},
         "timeline": _timeline_block(col),
@@ -407,10 +502,13 @@ def main(argv=None) -> int:
                     help="wire-leg attempts before declaring failure "
                          "(fresh chaos seed per attempt; counts are "
                          "probabilistic, see module docstring)")
-    ap.add_argument("--legs", default="wire,baseline,overhead,quorum",
-                    help="comma subset of wire,baseline,overhead,quorum "
-                         "(overhead reuses a preceding baseline leg's "
-                         "wall as its telemetry-on measurement)")
+    ap.add_argument("--legs", default="wire,baseline,overhead,quorum,"
+                                      "byzantine",
+                    help="comma subset of wire,baseline,overhead,quorum,"
+                         "byzantine (overhead reuses a preceding baseline "
+                         "leg's wall as its telemetry-on measurement; "
+                         "byzantine composes the wire lane with an "
+                         "adversarial peer — needs >= 3 peers)")
     ap.add_argument("--buffer-timeout", type=float, default=10.0)
     ap.add_argument("--deadline", type=float, default=600.0)
     ap.add_argument("--idle-timeout", type=float, default=120.0)
@@ -423,10 +521,17 @@ def main(argv=None) -> int:
         args.clients = 2 * args.peers
     legs = [s.strip() for s in args.legs.split(",") if s.strip()]
     bad = [s for s in legs
-           if s not in ("wire", "baseline", "overhead", "quorum")]
+           if s not in ("wire", "baseline", "overhead", "quorum",
+                        "byzantine")]
     if bad:
         print(f"unknown legs {bad}", file=sys.stderr)
         return 2
+    if "byzantine" in legs and args.peers < 3:
+        # trimmed_mean's arrival population must hold an honest majority
+        # around the one adversary
+        legs.remove("byzantine")
+        print("dist_chaos: skipping byzantine leg (needs >= 3 peers)",
+              flush=True)
 
     record = {"proof": "dist_chaos", "peers": args.peers,
               "clients": args.clients, "target_versions": args.rounds,
@@ -454,6 +559,23 @@ def main(argv=None) -> int:
                                               for a in attempts[:-1]]
         elif leg == "baseline":
             out = run_baseline_leg(args)
+        elif leg == "byzantine":
+            # same retry policy as the wire leg: the gated wire counters
+            # (retries/dups) are probabilistic per realized message
+            # sequence, so the leg gets a fresh chaos seed before
+            # declaring failure
+            attempts = []
+            for i in range(max(args.wire_attempts, 1)):
+                out = run_byzantine_leg(args,
+                                        chaos_seed=args.chaos_seed + i)
+                attempts.append(out)
+                if out["ok"]:
+                    break
+            out = attempts[-1]
+            out["attempts"] = len(attempts)
+            if len(attempts) > 1:
+                out["prior_attempt_gates"] = [a["gates"]
+                                              for a in attempts[:-1]]
         elif leg == "overhead":
             # reuse the baseline leg's telemetry-on wall only if that leg
             # actually completed — a broken run's wall is not a baseline

@@ -1,0 +1,593 @@
+"""Byzantine-tolerant dist runtime (ROBUSTNESS.md §8, RUNTIME.md §5).
+
+What this suite pins, layer by layer:
+
+- **FaultPlan byzantine lane** — seeded per-(peer, round) behavior draws:
+  identical coordinates always replay the identical behavior, honest
+  peers/spans draw None, and an armed-but-vacuous plan is rejected at
+  construction.
+- **ByzantineAdversary injection determinism** — for EVERY behavior in
+  ``BYZ_BEHAVIORS``: two independently constructed adversaries over equal
+  plans produce bit-identical mutated payloads; equivocation differs per
+  destination BY construction; replay resends a recorded honest header
+  verbatim; and a disabled/not-due lane passes the caller's objects
+  through IDENTICALLY (the clean-twin bit-match contract the baseline
+  legs of scripts/dist_byzantine.py gate end to end).
+- **Host-side robust merge** (bcfl_tpu.dist.robust) — trimmed_mean /
+  median / krum over the arrival set exclude a poisoned vote, flag it as
+  outlier evidence, honor zero-weight exclusions, and stay silent on
+  cohorts too small to judge (k < 3).
+- **Wire-evidence reputation** (bcfl_tpu.reputation.dist) — the evidence
+  lanes drive HEALTHY -> SUSPECT -> QUARANTINED on the unchanged PR 3
+  state machine; quarantine gates merge weight to zero; transitions
+  round-trip through reserved ledger rows (commit -> absorb) and through
+  the checkpoint arrays bit-for-bit.
+- **The `no_quarantined_merge` invariant** — fires exactly when a merge's
+  lineage includes an arrival from a peer quarantined at that leader
+  (peer-scoped, incarnation-scoped), and stays silent on clean runs,
+  client-scoped lifecycles, and post-readmission merges.
+- **measured_staleness clamp** — the leader-restart regression: a
+  negative raw staleness (restored version counter < sender base) clamps
+  to 0 and is surfaced, never silently weight-inflated.
+- **3-peer loopback integration** — one seeded adversary under
+  trimmed_mean + reputation + ledger: quarantine fires within the run,
+  post-ack refusals are recorded, every peer (followers via absorbed
+  ledger rows) holds the same verdict, and the full invariant suite is
+  clean over the collated streams.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from bcfl_tpu.dist.byzantine import ByzantineAdversary, _map_floats
+from bcfl_tpu.dist.robust import (
+    OUTLIER_MULT,
+    krum_min_buffer,
+    robust_merge,
+    trim_count,
+)
+from bcfl_tpu.dist.runtime import measured_staleness
+from bcfl_tpu.faults import BYZ_BEHAVIORS, FaultPlan
+from bcfl_tpu.reputation import ReputationConfig
+from bcfl_tpu.reputation.dist import (
+    REP_CLIENT_BASE,
+    DistReputationTracker,
+    decode_rep_row,
+    encode_rep_row,
+    rep_row_client,
+)
+from bcfl_tpu.telemetry.invariants import no_quarantined_merge
+
+pytestmark = pytest.mark.dist
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _plan(**kw):
+    kw.setdefault("seed", 11)
+    kw.setdefault("byz_peers", (1,))
+    return FaultPlan(**kw)
+
+
+def _tree():
+    """A tiny wire-ish tree: float leaves to poison, an int leaf that must
+    ride along untouched (quantized codes in the real codec payload)."""
+    return {
+        "layer/w": np.arange(6, dtype=np.float32).reshape(2, 3) / 7.0,
+        "layer/codes": np.arange(4, dtype=np.int8),
+        "nested": {"b": np.ones((3,), np.float32)},
+    }
+
+
+def _header():
+    return {"type": "update", "base_version": 3, "round": 5,
+            "wire_kind": "payload", "lineage": "ab" * 32,
+            "n_ex": [4, 4], "digests": ["cd" * 32, "ef" * 32],
+            "sent_at": 123.0}
+
+
+def _adv(plan, peer=1, clock=5):
+    state = {"r": clock}
+    a = ByzantineAdversary(plan, peer, clock_fn=lambda: state["r"])
+    a._clock_state = state  # test handle to move the clock
+    return a
+
+
+def _leaves(tree):
+    out = []
+    for k in sorted(tree):
+        v = tree[k]
+        out.extend(_leaves(v) if isinstance(v, dict) else [np.asarray(v)])
+    return out
+
+
+def _trees_equal(a, b):
+    return all(x.dtype == y.dtype and np.array_equal(x, y)
+               for x, y in zip(_leaves(a), _leaves(b)))
+
+
+# ------------------------------------------------------- FaultPlan byz lane
+
+
+def test_byz_action_deterministic_and_scoped():
+    plan = _plan(byz_prob=0.5)
+    draws = [plan.byz_action(r, 1) for r in range(40)]
+    again = [_plan(byz_prob=0.5).byz_action(r, 1) for r in range(40)]
+    assert draws == again  # identical coordinates -> identical behavior
+    acted = [d for d in draws if d is not None]
+    assert acted and len(acted) < 40  # byz_prob=0.5 genuinely bites both ways
+    assert all(d["behavior"] in BYZ_BEHAVIORS for d in acted)
+    # honest peers never draw; a bounded span only fires inside it
+    assert all(plan.byz_action(r, 0) is None for r in range(40))
+    spanned = _plan(byz_rounds=(2, 3))
+    assert spanned.byz_action(1, 1) is None
+    assert spanned.byz_action(2, 1) is not None
+
+
+def test_byz_rng_destination_keyed():
+    plan = _plan()
+    a = plan.byz_rng(4, 1, 0).standard_normal(8)
+    b = plan.byz_rng(4, 1, 0).standard_normal(8)
+    c = plan.byz_rng(4, 1, 2).standard_normal(8)
+    assert np.array_equal(a, b)  # same (round, peer, dst) -> same noise
+    assert not np.array_equal(a, c)  # equivocation differs per destination
+
+
+def test_byz_plan_validation_rejects_vacuous_lanes():
+    with pytest.raises(ValueError, match="byz_prob=0"):
+        _plan(byz_prob=0.0)
+    with pytest.raises(ValueError, match="never\\s+inject"):
+        FaultPlan(byz_rounds=(1, 2))  # rounds without peers
+    with pytest.raises(ValueError, match="empty"):
+        _plan(byz_rounds=())
+    with pytest.raises(ValueError, match="unknown byzantine behaviors"):
+        _plan(byz_behaviors=("scale", "nonsense"))
+    with pytest.raises(ValueError, match="twice"):
+        _plan(byz_peers=(1, 1))
+
+
+# ------------------------------------------------- adversary injection seam
+
+
+def test_disabled_lane_is_bit_identical_passthrough():
+    """The clean-twin contract: no lane, not this peer, or span not due
+    -> the CALLER'S OBJECTS come back, not copies of them."""
+    h, t = _header(), _tree()
+    for adv in (_adv(FaultPlan(), peer=1),           # lane off entirely
+                _adv(_plan(), peer=0),               # honest peer
+                _adv(_plan(byz_rounds=(9,)), peer=1, clock=5)):  # not due
+        h2, t2, act = adv.corrupt_update(h, t, dst=0)
+        assert act is None and h2 is h and t2 is t
+
+
+@pytest.mark.parametrize("behavior", [b for b in BYZ_BEHAVIORS
+                                      if b != "replay"])
+def test_each_behavior_injects_deterministically(behavior):
+    plan = _plan(byz_behaviors=(behavior,), byz_scale=10.0)
+    h1, t1, act1 = _adv(plan).corrupt_update(_header(), _tree(), dst=0)
+    h2, t2, act2 = _adv(plan).corrupt_update(_header(), _tree(), dst=0)
+    assert act1 is not None and act1["behavior"] == behavior
+    assert act1 == act2 and h1 == h2
+    assert _trees_equal(t1, t2)  # bit-identical mutation, fresh adversary
+    assert not _trees_equal(t1, _tree())  # ... and genuinely mutated
+    # int leaves ride along untouched — only float parts are poisoned
+    assert np.array_equal(t1["layer/codes"], _tree()["layer/codes"])
+    # the poisoning behaviors demand reannouncement (they must PASS ledger
+    # auth); forgery/equivocation keep the honest announcement (they must
+    # FAIL the leader's refingerprint)
+    expect_reannounce = behavior in ("scale", "sign_flip", "garbage")
+    assert act1["reannounce"] is expect_reannounce
+    if not expect_reannounce:
+        assert h1["digests"] == _header()["digests"]
+
+
+def test_equivocate_ships_different_bytes_per_destination():
+    plan = _plan(byz_behaviors=("equivocate",))
+    _, ta, _ = _adv(plan).corrupt_update(_header(), _tree(), dst=0)
+    _, tb, _ = _adv(plan).corrupt_update(_header(), _tree(), dst=2)
+    _, ta2, _ = _adv(plan).corrupt_update(_header(), _tree(), dst=0)
+    assert _trees_equal(ta, ta2)  # same destination -> same lie
+    assert not _trees_equal(ta, tb)  # different destination -> different lie
+
+
+def test_replay_resends_recorded_honest_update_verbatim():
+    # due only from round 6 on: round 5 is honest and gets recorded
+    plan = _plan(byz_behaviors=("replay",), byz_rounds=tuple(range(6, 20)))
+    adv = _adv(plan, clock=5)
+    h_old, t_old = _header(), _tree()
+    out_h, out_t, act = adv.corrupt_update(h_old, t_old, dst=0)
+    assert act is None and out_h is h_old  # honest round, recorded
+    adv._clock_state["r"] = 6
+    fresh_h = dict(_header(), base_version=7, round=6, lineage="99" * 32)
+    _, _, _ = fresh_h, None, None
+    out_h, out_t, act = adv.corrupt_update(fresh_h, _tree(), dst=0)
+    assert act is not None and act["behavior"] == "replay"
+    # the STALE header verbatim: old round / base_version / lineage
+    assert out_h["base_version"] == 3 and out_h["round"] == 5
+    assert out_h["lineage"] == h_old["lineage"]
+    assert _trees_equal(out_t, t_old)
+
+
+def test_replay_with_empty_history_acts_honestly_then_replays():
+    """An always-acting replayer (byz_prob=1.0) has no honest rounds to
+    harvest — its first acting round passes through honestly (recorded
+    as corpus; NEVER a substituted behavior the plan excluded), and
+    every later round replays."""
+    plan = _plan(byz_behaviors=("replay",))
+    adv = _adv(plan, clock=5)
+    h0, t0 = _header(), _tree()
+    h, t, act = adv.corrupt_update(h0, t0, dst=0)
+    assert act is None and h is h0 and t is t0  # honest, bit-identical
+    assert adv.stats()["total"] == 0
+    adv._clock_state["r"] = 6
+    fresh = dict(_header(), base_version=9, round=6)
+    h, t, act = adv.corrupt_update(fresh, _tree(), dst=0)
+    assert act is not None and act["behavior"] == "replay"
+    assert h["base_version"] == 3 and h["round"] == 5  # round 5's header
+    assert adv.stats()["injected"]["replay"] == 1
+
+
+def test_injection_counters_track_behaviors():
+    plan = _plan(byz_behaviors=("sign_flip",))
+    adv = _adv(plan)
+    for _ in range(3):
+        adv.corrupt_update(_header(), _tree(), dst=0)
+    s = adv.stats()
+    assert s["armed"] and s["total"] == 3
+    assert s["injected"]["sign_flip"] == 3
+
+
+def test_map_floats_preserves_structure_and_ints():
+    t = _tree()
+    out = _map_floats(t, lambda a: a * 2.0)
+    assert np.array_equal(out["layer/codes"], t["layer/codes"])
+    assert np.allclose(out["layer/w"], t["layer/w"] * 2.0)
+    assert np.allclose(out["nested"]["b"], 2.0)
+
+
+# ------------------------------------------------------- host robust merge
+
+
+def _votes(k=5, dim=4, poison=None, scale=100.0):
+    rng = np.random.default_rng(0)
+    votes = [{"w": rng.standard_normal(dim).astype(np.float32) * 0.01,
+              "b": {"x": rng.standard_normal(2).astype(np.float32) * 0.01}}
+             for _ in range(k)]
+    if poison is not None:
+        votes[poison] = {
+            "w": np.full((dim,), scale, np.float32),
+            "b": {"x": np.full((2,), scale, np.float32)}}
+    return votes
+
+
+@pytest.mark.parametrize("rule", ["trimmed_mean", "median", "krum"])
+def test_robust_rules_exclude_poisoned_vote(rule):
+    votes = _votes(k=5, poison=2)
+    agg, flags, info = robust_merge(votes, [1.0] * 5, rule, trim=0.2)
+    # the aggregate stays at honest magnitude — the poison never lands
+    assert all(np.abs(leaf).max() < 1.0 for leaf in _leaves(agg))
+    assert flags[2] and sum(flags) == 1  # ... and is flagged as evidence
+    assert info["k"] == 5 and info["rule"] == rule
+    if rule == "krum":
+        assert info["krum_selected"] != 2
+    # distances align with the arrival list (reputation zips them)
+    d = info["distances"]
+    assert len(d) == 5 and d[2] == max(x for x in d if x is not None)
+
+
+def test_robust_merge_zero_weight_excluded_not_flagged():
+    votes = _votes(k=4, poison=3)
+    agg, flags, info = robust_merge(votes, [1.0, 1.0, 1.0, 0.0],
+                                    "trimmed_mean")
+    assert info["k"] == 3  # the zero-weight arrival is not a vote
+    assert not flags[3]  # excluded != outlier: its evidence was the auth
+    assert all(np.abs(leaf).max() < 1.0 for leaf in _leaves(agg))
+    assert info["distances"][3] is None
+
+
+def test_robust_merge_small_cohort_never_flags():
+    agg, flags, info = robust_merge(_votes(k=2, poison=1), [1.0, 1.0],
+                                    "median")
+    assert agg is not None and not any(flags)  # k < 3: no cohort to judge
+    assert "distances" not in info
+
+
+def test_robust_merge_all_eliminated_returns_none():
+    agg, flags, info = robust_merge(_votes(k=3), [0.0, 0.0, 0.0], "median")
+    assert agg is None and info.get("empty") and not any(flags)
+
+
+def test_robust_merge_structure_and_dtype_preserved():
+    votes = _votes(k=3)
+    agg, _, _ = robust_merge(votes, [1.0] * 3, "median")
+    assert set(agg) == {"w", "b"} and set(agg["b"]) == {"x"}
+    assert agg["w"].dtype == np.float32 and agg["w"].shape == (4,)
+
+
+def test_robust_merge_rejects_unknown_rule_and_empty():
+    with pytest.raises(ValueError, match="unknown robust rule"):
+        robust_merge(_votes(k=3), [1.0] * 3, "mean")
+    with pytest.raises(ValueError, match="at least one"):
+        robust_merge([], [], "median")
+
+
+def test_trim_and_krum_preconditions_match_declared_math():
+    assert trim_count(5, 0.2) == 1 and trim_count(3, 0.2) == 1
+    assert trim_count(1, 0.9) == 0  # at least one vote survives
+    assert krum_min_buffer(5, 0.2) == 5  # f=1 -> 2f+3
+    assert krum_min_buffer(10, 0.2) == 7  # f=2
+
+
+# ------------------------------------------------- staleness clamp (fix)
+
+
+def test_measured_staleness_clamps_leader_restart_regression():
+    """decay ** negative would INFLATE a from-the-future update's merge
+    weight after a leader restart — the clamp pins the exponent at 0 and
+    surfaces the disagreement instead."""
+    assert measured_staleness(5, 3) == (2, False)
+    assert measured_staleness(5, 5) == (0, False)
+    # restored leader counter (3) below a sender's adopted base (5)
+    assert measured_staleness(3, 5) == (0, True)
+    # the surfaced warn event type is part of the declared catalogue
+    from bcfl_tpu.telemetry.events import EVENT_TYPES
+
+    assert "warn" in EVENT_TYPES and "byz.inject" in EVENT_TYPES
+    assert "rep.dist_evidence" in EVENT_TYPES
+
+
+# ------------------------------------------------ reserved ledger-row codec
+
+
+def test_rep_row_roundtrip_and_rejections():
+    digest = encode_rep_row(2, 2, 7, 3, 0.3168)
+    assert len(digest) == 32
+    snap = decode_rep_row(rep_row_client(2), digest)
+    assert snap == {"peer": 2, "state": 2, "timer": 7, "events": 3,
+                    "trust": 0.3168}
+    # ordinary client ids / foreign digest bytes / mismatched peer binding
+    assert decode_rep_row(5, digest) is None
+    assert decode_rep_row(rep_row_client(2), os.urandom(32)) is None
+    assert decode_rep_row(rep_row_client(1), digest) is None
+    assert rep_row_client(0) == REP_CLIENT_BASE
+
+
+def test_rep_transitions_commit_and_absorb_via_real_ledger():
+    from bcfl_tpu.ledger import Ledger
+
+    cfg = ReputationConfig(enabled=True)
+    leader = DistReputationTracker(cfg, peers=3, self_id=0)
+    # two hard-auth offenses -> QUARANTINED (trust 1 -> .6 -> .36)
+    for _ in range(2):
+        leader.note_auth_failure(2, 1.0)
+        transitions = leader.observe_merge([1, 2])
+    assert leader.is_quarantined(2)
+    assert ("quarantined" in [t[2] for t in transitions])
+    chain = Ledger(use_native=False)
+    chain.append_digest(0, 4, b"\x01" * 32, 100)  # an ordinary update row
+    assert leader.commit_transitions(chain, 5, transitions) == len(
+        transitions)
+    assert chain.verify_chain() == -1  # reserved rows chain like any entry
+    # a follower replays the adopted segment and inherits the verdict
+    follower = DistReputationTracker(cfg, peers=3, self_id=1)
+    applied = follower.absorb_rows(chain.segment(0))
+    assert applied == len(transitions)
+    assert follower.is_quarantined(2)
+    assert follower.tracker.trust[2] == leader.tracker.trust[2]
+    assert follower.tracker.timer[2] == leader.tracker.timer[2]
+    # garbage rows are skipped, never raise
+    assert follower.absorb_rows([{"bad": 1}, None, {"client": "x"}]) == 0
+
+
+def test_rep_tracker_checkpoint_roundtrip_bitwise():
+    cfg = ReputationConfig(enabled=True)
+    a = DistReputationTracker(cfg, peers=4, self_id=0)
+    a.note_auth_failure(1, 1.0)
+    a.note_outlier(2, distance=5.0)
+    a.note_staleness(3, cfg.staleness_limit + 3)
+    a.observe_merge([1, 2, 3])
+    state = a.checkpoint_state()
+    b = DistReputationTracker(cfg, peers=4, self_id=0)
+    b.restore(state)
+    for k in ("trust", "state", "timer"):
+        assert np.array_equal(getattr(a.tracker, k), getattr(b.tracker, k))
+    # the report's hex trust is the bit-identity evidence the resume
+    # proof compares (rounded floats are for humans)
+    assert a.report()["trust_hex"] == b.report()["trust_hex"]
+
+
+def test_rep_evidence_lanes_drive_the_state_machine():
+    cfg = ReputationConfig(enabled=True)
+    t = DistReputationTracker(cfg, peers=3, self_id=0)
+    # outlier lane alone (w_anomaly=.5): EWMA fixed point is exactly 0.5
+    # — a pure poisoner parks at SUSPECT with its merge weight halved,
+    # and only harder (auth) or combined evidence crosses into quarantine
+    for _ in range(6):
+        t.note_outlier(1)
+        t.observe_merge([1])
+    from bcfl_tpu.reputation.lifecycle import SUSPECT
+
+    assert int(t.tracker.state[1]) == SUSPECT
+    assert t.tracker.trust[1] == pytest.approx(0.5, abs=0.03)
+    assert 0.0 < t.gate(1) < 1.0  # trust-scaled, not excluded
+    t.note_auth_failure(1, 1.0)  # the hard lane tips it over
+    t.observe_merge([1])
+    assert t.is_quarantined(1)
+    # staleness below the limit is NOT evidence
+    t.note_staleness(2, cfg.staleness_limit)
+    assert t._pending[2] == 0.0
+    t.note_staleness(2, cfg.staleness_limit + 1)
+    assert t._pending[2] == cfg.w_staleness
+    # evidence combines by max, never sums past the hard lane
+    t.note_replay(2, "fork lineage mismatch")
+    t.note_auth_failure(2, 1.0)
+    assert t._pending[2] == cfg.w_auth
+    # quarantine gates merge weight to zero; honest peers keep trust-scaled
+    assert t.gate(1) == 0.0
+    assert t.gate(0) == pytest.approx(1.0)
+    # a peer with evidence but NO arrival still advances (rejected replays)
+    before = t.tracker.trust[2]
+    t.observe_merge([])
+    assert t.tracker.trust[2] < before
+
+
+def test_rep_detector_down_is_weakest_lane():
+    cfg = ReputationConfig(enabled=True)
+    t = DistReputationTracker(cfg, peers=2, self_id=0)
+    t.note_detector_down(1)
+    assert t._pending[1] == cfg.w_staleness  # death != malice: 0.25, not 1
+    t.observe_merge([])
+    assert not t.is_quarantined(1)
+
+
+# ------------------------------------------- no_quarantined_merge invariant
+
+
+def _ev(ev, peer, seq, t, pid=7001, **fields):
+    rec = {"v": 1, "ev": ev, "run": "fx", "peer": peer, "pid": pid,
+           "seq": seq, "t_wall": t, "t_mono": t}
+    rec.update(fields)
+    return rec
+
+
+def _qtrans(peer, seq, t, client, to="quarantined", scope="peer", pid=7001):
+    return _ev("rep.transition", peer, seq, t, pid=pid, client=client,
+               scope=scope, trust=0.3, **{"from": "suspect", "to": to})
+
+
+def _mrg(peer, seq, t, version, from_peers, pid=7001):
+    return _ev("merge", peer, seq, t, pid=pid, version=version, leader=peer,
+               arrivals=[{"peer": p, "msg_id": i, "msg_epoch": 1}
+                         for i, p in enumerate(from_peers)],
+               rejected=[], solo=False, degraded=False, component=[0, 1, 2])
+
+
+def test_invariant_fires_on_post_quarantine_merge():
+    events = [
+        _mrg("A", 1, 10.0, 1, [1, 2]),     # pre-quarantine: legal
+        _qtrans("A", 2, 11.0, client=2),
+        _mrg("A", 3, 12.0, 2, [1, 2]),     # peer 2 quarantined: violation
+    ]
+    out = no_quarantined_merge(events)
+    assert len(out) == 1
+    assert out[0]["from_peer"] == 2 and out[0]["version"] == 2
+
+
+def test_invariant_clean_when_quarantined_peer_excluded():
+    events = [
+        _qtrans("A", 1, 10.0, client=2),
+        _mrg("A", 2, 11.0, 1, [0, 1]),  # the gate held: only honest peers
+    ]
+    assert no_quarantined_merge(events) == []
+
+
+def test_invariant_scoped_to_peer_population_and_incarnation():
+    # a CLIENT-scoped lifecycle transition (the local engine) says nothing
+    # about peers — same event types, different population
+    events = [
+        _qtrans("A", 1, 10.0, client=2, scope="client"),
+        _mrg("A", 2, 11.0, 1, [2]),
+    ]
+    assert no_quarantined_merge(events) == []
+    # a new leader incarnation (fresh pid) starts from its own declared
+    # state — which is exactly why a resumed leader re-declares restored
+    # quarantines into its stream (PeerRuntime._restore)
+    events = [
+        _qtrans("A", 1, 10.0, client=2, pid=7001),
+        _mrg("A", 1, 20.0, 5, [2], pid=7002),  # restarted, no declaration
+    ]
+    assert no_quarantined_merge(events) == []
+    redeclared = [
+        _qtrans("A", 1, 10.0, client=2, pid=7001),
+        _qtrans("A", 1, 19.0, client=2, pid=7002),  # restore re-declares
+        _mrg("A", 2, 20.0, 5, [2], pid=7002),
+    ]
+    assert len(no_quarantined_merge(redeclared)) == 1
+
+
+def test_invariant_clears_on_readmission():
+    events = [
+        _qtrans("A", 1, 10.0, client=2),
+        _qtrans("A", 2, 12.0, client=2, to="probation"),
+        _mrg("A", 3, 13.0, 2, [2]),  # readmitted on probation: legal
+    ]
+    assert no_quarantined_merge(events) == []
+
+
+def test_invariant_registered_in_the_suite():
+    from bcfl_tpu.telemetry.invariants import INVARIANTS
+
+    assert "no_quarantined_merge" in INVARIANTS
+    fn, desc = INVARIANTS["no_quarantined_merge"]
+    assert fn is no_quarantined_merge and "quarantined" in desc
+
+
+# ------------------------------------------------------ loopback integration
+
+
+def test_three_peer_loopback_quarantines_seeded_adversary(tmp_path):
+    """The tentpole end to end on CPU loopback (~60 s): peer 2 forges and
+    poisons under trimmed_mean + reputation + ledger. Gates: clean
+    completion; the adversary QUARANTINED at the leader within the run
+    AND at the followers (absorbed from broadcast ledger rows); post-ack
+    refusals recorded; nonzero injection counters at the adversary,
+    exactly zero at honest peers; zero violations across the whole
+    invariant suite (incl. no_quarantined_merge); chains verify."""
+    from bcfl_tpu.config import (
+        DistConfig,
+        FedConfig,
+        LedgerConfig,
+        PartitionConfig,
+    )
+    from bcfl_tpu.dist.harness import run_dist
+    from bcfl_tpu.telemetry import collate
+
+    cfg = FedConfig(
+        name="byz_loopback", runtime="dist", mode="server", sync="async",
+        model="tiny-bert", dataset="synthetic",
+        num_clients=6, num_rounds=5, seq_len=16, batch_size=4,
+        max_local_batches=2, eval_every=0, seed=42,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        aggregator="trimmed_mean",
+        reputation=ReputationConfig(enabled=True, quarantine_rounds=1000),
+        faults=FaultPlan(seed=7, byz_peers=(2,), byz_prob=1.0,
+                         byz_behaviors=("scale", "digest_forge")),
+        dist=DistConfig(peers=3, buffer=3, buffer_timeout_s=8.0,
+                        idle_timeout_s=90.0, peer_deadline_s=280.0,
+                        checkpoint_every_versions=1),
+    )
+    run_dir = str(tmp_path / "byz_loopback")
+    res = run_dist(cfg, run_dir, deadline_s=320.0, platform="cpu")
+    assert res["ok"], (res["returncodes"], res["log_tails"])
+    reports = res["reports"]
+    assert len(reports) == 3
+    leader = reports[0]
+    rep = leader["reputation"]
+    assert rep["state"][2] == "quarantined", rep
+    assert rep["quarantine_drops"] > 0  # post-ack refusals actually fired
+    # followers inherited the verdict from the broadcast chain suffix
+    for p in (1, 2):
+        assert reports[p]["reputation"]["state"][2] == "quarantined"
+    # injection counters: nonzero at the adversary, zero elsewhere
+    assert reports[2]["byzantine"]["armed"]
+    assert reports[2]["byzantine"]["total"] > 0
+    for p in (0, 1):
+        assert reports[p]["byzantine"]["total"] == 0
+    # robust merges recorded their rule on the lineage
+    robust_rules = {(m.get("robust") or {}).get("rule")
+                    for r in reports.values() for m in r["merges"]}
+    assert "trimmed_mean" in robust_rules
+    # the full invariant suite over the collated streams — including
+    # no_quarantined_merge: zero post-quarantine merges
+    col = collate(res["event_streams"])
+    assert col["ok"], col["violations"]
+    assert col["invariants"]["no_quarantined_merge"] == 0
+    assert all(r.get("chain_ok") in (True, None) for r in reports.values())

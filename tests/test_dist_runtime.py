@@ -164,11 +164,14 @@ def test_capability_table_is_total_and_enforced():
     # every row resolves to supported (True) or a declared reason (str)
     for feature, active, verdict in rows:
         assert verdict is True or (isinstance(verdict, str) and verdict)
-    # the local runtime supports everything except the wire lane — the one
-    # feature that only exists at a real socket boundary
+    # the local runtime supports everything except the wire and byzantine
+    # lanes — the two features that only exist at a real socket boundary
+    # (frames to damage, wire headers/digest announcements to forge)
     for feature, _, verdict in capability_table(FedConfig()):
         if feature.startswith("chaos: wire"):
             assert isinstance(verdict, str) and "socket" in verdict
+        elif feature.startswith("chaos: byzantine"):
+            assert isinstance(verdict, str) and "wire" in verdict
         else:
             assert verdict is True
 
@@ -180,7 +183,6 @@ def test_capability_table_is_total_and_enforced():
     (dict(eval_every=1), "eval"),
     (dict(donate=True), "donat"),
     (dict(rounds_per_dispatch=4), "fuse"),
-    (dict(aggregator="krum"), "order statistics"),
     (dict(registry_size=100, sample_clients=4), "registry"),
     (dict(faults=FaultPlan(dropout_prob=0.5)), "dropout"),
     (dict(faults=FaultPlan(corrupt_prob=0.5)), "wire lane"),
@@ -197,7 +199,8 @@ def test_dist_rejections_come_from_the_table(kw, needle):
 
 def test_dist_supported_combinations_construct():
     from bcfl_tpu.compression import CompressionConfig
-    from bcfl_tpu.config import LedgerConfig
+    from bcfl_tpu.config import DistConfig, LedgerConfig
+    from bcfl_tpu.reputation import ReputationConfig
 
     cfg = _dist_cfg(
         ledger=LedgerConfig(enabled=True),
@@ -209,6 +212,44 @@ def test_dist_supported_combinations_construct():
     # the same plan on runtime='local' keeps the pre-existing semantics
     FedConfig(faults=FaultPlan(partition_groups=((0, 1), (2, 3)),
                                partition_rounds=(1, 2)))
+    # the PR 10 flips: robust aggregators (with a big-enough buffer),
+    # reputation, and the byzantine lane now compose on dist
+    cfg = _dist_cfg(aggregator="trimmed_mean", num_clients=6,
+                    reputation=ReputationConfig(enabled=True),
+                    faults=FaultPlan(byz_peers=(1,)),
+                    dist=DistConfig(peers=3, buffer=3))
+    assert cfg.aggregator == "trimmed_mean"
+    assert cfg.reputation.enabled and cfg.faults.byz_enabled
+    # ... but an ALL-adversarial federation is rejected: no honest
+    # majority exists for any rule to defend
+    with pytest.raises(ValueError, match="EVERY peer"):
+        _dist_cfg(faults=FaultPlan(byz_peers=(0, 1)))
+
+
+def test_dist_robust_aggregator_preconditions():
+    """Supported-with-preconditions (RUNTIME.md §5): the robust rules'
+    population is the buffered arrival set, so the merge buffer must be
+    large enough for the rule's breakdown point to mean anything —
+    enforced at config time, replacing the old blanket rejection."""
+    from bcfl_tpu.config import DistConfig
+
+    # order statistics need >= 3 votes to exclude anything
+    with pytest.raises(ValueError, match="dist.buffer >= 3"):
+        _dist_cfg(aggregator="trimmed_mean")
+    with pytest.raises(ValueError, match="dist.buffer >= 3"):
+        _dist_cfg(aggregator="median", dist=DistConfig(peers=2, buffer=2))
+    # krum's classical k >= 2f+3 selection precondition
+    with pytest.raises(ValueError, match="2f\\+3"):
+        _dist_cfg(aggregator="krum", num_clients=6,
+                  dist=DistConfig(peers=3, buffer=3))
+    # ... all satisfied at the declared minimum
+    _dist_cfg(aggregator="trimmed_mean", num_clients=6,
+              dist=DistConfig(peers=3, buffer=3))
+    _dist_cfg(aggregator="median", num_clients=6,
+              dist=DistConfig(peers=3, buffer=3))
+    # trim 0.2, buffer 5 -> f = 1 -> need 5: exactly satisfied
+    _dist_cfg(aggregator="krum", num_clients=5,
+              dist=DistConfig(peers=5, buffer=5))
 
 
 def test_wire_lane_is_dist_only():

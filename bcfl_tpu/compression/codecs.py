@@ -182,12 +182,11 @@ def codec_key(stacked_keys) -> jax.Array:
     return jax.random.fold_in(stacked_keys[0], _CODEC_LANE)
 
 
-def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
-    """Stacked [C, ...] f32 delta tree -> payload dict keyed by leaf path.
-
-    The payload is a plain pytree (dict of dicts of arrays), so it flows
-    through jit/scan, shards on the client axis, fingerprints via
-    ``client_fingerprint``, and device_gets like any other tree."""
+def encode_tree_unfused(comp: CompressionConfig, delta: Tree, key) -> dict:
+    """Per-leaf reference encoder: one generic quantize/top-k lowering per
+    leaf. Kept as the bit-identity oracle for the fused path below
+    (tests/test_compression.py pins fused == unfused); the production
+    entrypoint is :func:`encode_tree`."""
     flat = jax.tree_util.tree_flatten_with_path(delta)[0]
     if not flat:
         raise ValueError("cannot encode an empty tree")
@@ -198,6 +197,125 @@ def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
         out[_path_name(path)] = _encode_leaf(
             comp, y, jax.random.fold_in(key, i))
     return out
+
+
+def _int8_parts_batched(ys, keys, chunk: int, stochastic: bool):
+    """Fused int8 quantize over several [C, N_i] leaves sharing one chunk
+    size: each leaf is padded to its chunk grid exactly as
+    :func:`_int8_parts` would, the grids are CONCATENATED along the chunk
+    axis, and the scale/divide/round/clip/cast pipeline runs ONCE over the
+    union — per-chunk groupings (and the per-leaf stochastic-rounding
+    uniforms, drawn under each leaf's own fold_in key) are unchanged, so
+    the split-back parts are bit-identical to the per-leaf encode.
+
+    Returns [(q, scale)] in input order."""
+    grids, Ms = [], []
+    for y in ys:
+        C, N = y.shape
+        pad = (-N) % chunk
+        if pad:
+            y = jnp.pad(y, ((0, 0), (0, pad)))
+        M = (N + pad) // chunk
+        grids.append(y.reshape(C, M, chunk))
+        Ms.append(M)
+    g = jnp.concatenate(grids, axis=1)  # [C, sum(M), chunk]
+    scale = jnp.max(jnp.abs(g), axis=-1) / 127.0
+    z = g / jnp.maximum(scale, 1e-30)[..., None]
+    if stochastic:
+        # per-leaf uniforms under each leaf's own key (the identity with
+        # the unfused path), concatenated along the same chunk axis
+        u = jnp.concatenate(
+            [jax.random.uniform(k, grid.shape)
+             for k, grid in zip(keys, grids)], axis=1)
+        z = jnp.floor(z + u)
+    else:
+        z = jnp.round(z)
+    q = jnp.clip(z, -127.0, 127.0).astype(jnp.int8)
+    out, off = [], 0
+    for M in Ms:
+        out.append((q[:, off:off + M], scale[:, off:off + M]
+                    .astype(jnp.float32)))
+        off += M
+    return out
+
+
+def _topk_parts_batched(ys, k: int):
+    """Fused top-k over several [C, N] leaves of ONE flattened width:
+    stacked to [L*C, N], a single ``lax.top_k`` sorts every row — top_k is
+    row-independent, so each leaf's (val, idx) rows are bit-identical to
+    its standalone call. Returns [(val, idx)] in input order."""
+    L = len(ys)
+    C, N = ys[0].shape
+    stacked = jnp.concatenate(ys, axis=0)  # [L*C, N]
+    _, idx = jax.lax.top_k(jnp.abs(stacked), k)
+    val = jnp.take_along_axis(stacked, idx, axis=1)
+    idx = idx.astype(jnp.int32)
+    return [(val[i * C:(i + 1) * C], idx[i * C:(i + 1) * C])
+            for i in range(L)]
+
+
+def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
+    """Stacked [C, ...] f32 delta tree -> payload dict keyed by leaf path.
+
+    The payload is a plain pytree (dict of dicts of arrays), so it flows
+    through jit/scan, shards on the client axis, fingerprints via
+    ``client_fingerprint``, and device_gets like any other tree.
+
+    FUSED dispatch (the comms hot path): instead of lowering one generic
+    quantize / top-k per leaf, leaves are grouped — every leaf joins ONE
+    concatenated int8 chunk-grid quantize, and leaves sharing a flattened
+    width share ONE stacked ``lax.top_k`` (a transformer's N identical
+    layers collapse to one call per distinct shape). The math is arranged
+    so every per-leaf part is BIT-IDENTICAL to the per-leaf reference
+    encode (:func:`encode_tree_unfused` — chunk groupings, per-leaf
+    stochastic-rounding keys, and top-k row independence are all
+    preserved), so ledger digests, wire frames, and checkpointed
+    error-feedback state are unchanged. All shapes stay trace-time static:
+    zero per-round retraces, pinned in tests/test_compression.py."""
+    flat = jax.tree_util.tree_flatten_with_path(delta)[0]
+    if not flat:
+        raise ValueError("cannot encode an empty tree")
+    paths, ys, keys = [], [], []
+    for i, (path, x) in enumerate(flat):
+        C = x.shape[0]
+        paths.append(_path_name(path))
+        ys.append(x.reshape(C, -1).astype(jnp.float32))
+        keys.append(jax.random.fold_in(key, i))
+    out: dict = {}
+    if comp.kind in ("topk", "int8+topk"):
+        # group by flattened width (same n => same k => stackable rows)
+        by_n: dict = {}
+        for i, y in enumerate(ys):
+            by_n.setdefault(y.shape[1], []).append(i)
+        vals = [None] * len(ys)
+        idxs = [None] * len(ys)
+        for n, group in by_n.items():
+            parts = _topk_parts_batched([ys[i] for i in group],
+                                        _leaf_k(comp, n))
+            for i, (v, ix) in zip(group, parts):
+                vals[i], idxs[i] = v, ix
+        if comp.kind == "topk":
+            for p, v, ix in zip(paths, vals, idxs):
+                out[p] = {"v": v, "i": ix}
+            return out
+        # int8+topk: quantize the surviving values, fused per chunk size
+        # min(chunk, k) — leaves sharing a width share a k, hence a grid
+        by_ck: dict = {}
+        for i, v in enumerate(vals):
+            by_ck.setdefault(min(comp.chunk, v.shape[1]), []).append(i)
+        for ck, group in by_ck.items():
+            parts = _int8_parts_batched(
+                [vals[i] for i in group], [keys[i] for i in group],
+                ck, comp.stochastic)
+            for i, (q, s) in zip(group, parts):
+                out[paths[i]] = {"q": q, "s": s, "i": idxs[i]}
+        return out
+    if comp.kind == "int8":
+        parts = _int8_parts_batched(ys, keys, comp.chunk, comp.stochastic)
+        for p, (q, s) in zip(paths, parts):
+            out[p] = {"q": q, "s": s}
+        return out
+    raise ValueError(f"unknown compression kind {comp.kind!r}")
 
 
 def decode_tree(comp: CompressionConfig, payload: dict, like: Tree) -> Tree:

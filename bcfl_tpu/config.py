@@ -170,6 +170,21 @@ class DistConfig:
     # component the leader refuses to advance the global at all (the idle
     # watchdog bounds that wait)
     quorum_frac: float = 0.5
+    # --- comms/compute overlap (RUNTIME.md §4, PERF.md) ---
+    # pipeline=True (default) overlaps communication with computation:
+    # update sends and global broadcasts go through per-destination sender
+    # WORKERS (the round loop enqueues and immediately starts the next
+    # local round; retries/backoff/detector feeding run in the worker),
+    # and the leader drains arrivals on an INTAKE thread into a
+    # double-buffered FedBuff buffer (merge/verify consumes a swapped-out
+    # buffer while intake keeps filling the standby one). False = the
+    # PR 7-10 serial loop, bit-compatible — the wire_perf.py A/B baseline.
+    pipeline: bool = True
+    # bounded per-destination handoff queue depth for the sender workers:
+    # when a destination is slower than the round loop, enqueue BLOCKS
+    # after this many frames (back-pressure) instead of buffering
+    # model-sized trees without bound
+    pipeline_depth: int = 2
 
     def __post_init__(self):
         if self.peers < 2:
@@ -204,6 +219,9 @@ class DistConfig:
         if not 0.0 < self.quorum_frac <= 1.0:
             raise ValueError(
                 f"quorum_frac must be in (0, 1], got {self.quorum_frac}")
+        if self.pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {self.pipeline_depth}")
 
 
 # --- runtime capability table (RUNTIME.md §2) --------------------------------

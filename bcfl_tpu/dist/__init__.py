@@ -17,10 +17,17 @@ from bcfl_tpu.dist.transport import (
     TransportError,
     WireChaos,
 )
-from bcfl_tpu.dist.wire import pack_frame, read_frame, unpack_frame
+from bcfl_tpu.dist.wire import (
+    frame_prefix,
+    pack_frame,
+    read_frame,
+    unpack_frame,
+    write_frame,
+)
 
 __all__ = [
     "FailureDetector", "PartitionGate", "PeerTransport", "TransportError",
-    "WireChaos", "cfg_from_json", "cfg_to_json", "free_ports", "pack_frame",
-    "read_frame", "reap_all", "run_dist", "unpack_frame",
+    "WireChaos", "cfg_from_json", "cfg_to_json", "frame_prefix",
+    "free_ports", "pack_frame", "read_frame", "reap_all", "run_dist",
+    "unpack_frame", "write_frame",
 ]

@@ -55,6 +55,7 @@ import dataclasses
 import json
 import logging
 import os
+import queue
 import signal
 import threading
 import time
@@ -188,6 +189,21 @@ class PeerRuntime:
         self._below_quorum_events = 0  # episodes, not loop polls
         self._buffer: List[tuple] = []  # (header, trees, recv_time)
         self._buffer_shed = 0  # oldest entries shed by the intake cap
+        # double-buffered intake (cfg.dist.pipeline, RUNTIME.md §4): an
+        # intake thread drains the transport inbox continuously — UPDATE
+        # arrivals land in self._buffer under this lock (the active
+        # arrival buffer), everything else routes to the control queue
+        # the main loop drains. _maybe_merge SWAPS the arrival buffer out
+        # under the lock and merges the swapped-out one while intake
+        # keeps filling the fresh standby — merge/verify overlaps intake
+        # instead of serializing behind it.
+        self._buffer_lock = threading.Lock()
+        self._ctrl: "queue.Queue" = queue.Queue()
+        self._intake_thread: Optional[threading.Thread] = None
+        # quarantine_drops is bumped from the intake thread (_intake_update)
+        # AND the main merge thread (_prepare_update): a plain += there is
+        # a racy read-add-store, same class transport._bump guards against
+        self._qdrop_lock = threading.Lock()
         # when the CURRENT merge window opened (first entry into an empty
         # buffer): the buffer_timeout_s clock. Deliberately not the oldest
         # surviving entry's timestamp — the intake cap sheds oldest-first,
@@ -451,10 +467,19 @@ class PeerRuntime:
                 "msg_id": self.transport.alloc_msg_id(self.peer_id),
                 "msg_epoch": self.transport.epoch}),
                 {"payload": wire_tree}, time.time()))
+        elif self.cfg.dist.pipeline:
+            # pipelined: hand the frame to the per-destination sender
+            # worker and immediately start the next local round — the
+            # retry/backoff/detector protocol runs in the worker while
+            # this peer trains (comms/compute overlap, RUNTIME.md §4).
+            # The bounded handoff blocks when the link is slower than
+            # training (back-pressure), so frames can't pile up.
+            self.transport.send_async(leader, header,
+                                      {"payload": wire_tree})
         else:
-            # the transport's retrying seam owns failure handling (backoff,
-            # detector, counters); an undelivered update simply rebases on
-            # the next global broadcast
+            # serial (pipeline=False): the transport's retrying seam owns
+            # failure handling inline; an undelivered update simply
+            # rebases on the next global broadcast
             self.transport.send(leader, header, {"payload": wire_tree})
 
     def _announce_digests(self, wire_kind: str, tree_np) -> List[str]:
@@ -474,14 +499,16 @@ class PeerRuntime:
         and each entry holds a model-sized wire tree — an uncapped list
         would grow to OOM before the idle watchdog fires. Shed the OLDEST
         (its stale lineage would be the first rejected at the eventual
-        merge anyway)."""
+        merge anyway). Called from the main loop AND (pipeline on) the
+        intake thread — all buffer state moves under the buffer lock."""
         cap = max(4, 2 * self.peers, 2 * (self.cfg.dist.buffer or 1))
-        if not self._buffer:
-            self._buffer_since = entry[2]  # a new merge window opens
-        self._buffer.append(entry)
-        while len(self._buffer) > cap:
-            self._buffer.pop(0)
-            self._buffer_shed += 1
+        with self._buffer_lock:
+            if not self._buffer:
+                self._buffer_since = entry[2]  # a new merge window opens
+            self._buffer.append(entry)
+            while len(self._buffer) > cap:
+                self._buffer.pop(0)
+                self._buffer_shed += 1
 
     def _maybe_merge(self):
         import math
@@ -529,8 +556,6 @@ class PeerRuntime:
                 self.transport.send(p, {"type": "ping"})
             return
         self._below_quorum = False
-        if not self._buffer:
-            return
         # the buffer target counts DISTINCT senders, not buffered entries:
         # a fast peer (or a flooding adversary) can park several of its own
         # updates before a slow peer lands one, and a robust rule graded
@@ -538,12 +563,20 @@ class PeerRuntime:
         # population is PEERS — k entries from one sender are one voice
         # (and one vote: _apply_robust_merge groups by sender). The
         # buffer_timeout still bounds the wait for stragglers.
+        # Target check and swap are ONE critical section: the intake
+        # thread keeps pushing concurrently, and the swap hands merge a
+        # consistent snapshot while arrivals land in the fresh standby
+        # buffer (the double-buffer seam).
         want = min(cfg.dist.buffer or 1, len(alive))
-        distinct = len({int(h.get("from", -1)) for h, _, _ in self._buffer})
-        if (distinct < want and time.time() - self._buffer_since
-                < cfg.dist.buffer_timeout_s):
-            return
-        buf, self._buffer = self._buffer, []
+        with self._buffer_lock:
+            if not self._buffer:
+                return
+            distinct = len({int(h.get("from", -1))
+                            for h, _, _ in self._buffer})
+            if (distinct < want and time.time() - self._buffer_since
+                    < cfg.dist.buffer_timeout_s):
+                return
+            buf, self._buffer = self._buffer, []
         t0 = time.time()
         arrivals, rejected, weighted = [], [], []
         for header, trees, recv_t in buf:
@@ -729,7 +762,8 @@ class PeerRuntime:
         # the no_quarantined_merge invariant holds the stream to
         if (self.rep is not None and src != self.peer_id
                 and self.rep.is_quarantined(src)):
-            self.rep.quarantine_drops += 1
+            with self._qdrop_lock:
+                self.rep.quarantine_drops += 1
             rec["rejected"] = "peer quarantined (post-ack gate)"
             return {"ok": False, "rec": rec}
         # lineage check (BOTH wire formats) BEFORE anything touches the
@@ -882,8 +916,15 @@ class PeerRuntime:
             if p == self.peer_id:
                 continue
             # retrying seam; a peer that misses the broadcast resyncs via
-            # HELLO, and a dead one trips the detector toward DOWN
-            self.transport.send(p, header, {"model": model})
+            # HELLO, and a dead one trips the detector toward DOWN. With
+            # the pipeline on, broadcasts ride the same per-destination
+            # sender workers as updates (FIFO per destination, so version
+            # N always hits the wire before N+1) and the leader starts
+            # its next round while the model streams out.
+            if self.cfg.dist.pipeline:
+                self.transport.send_async(p, header, {"model": model})
+            else:
+                self.transport.send(p, header, {"model": model})
 
     # --------------------------------------------------- partition lifecycle
 
@@ -1226,22 +1267,58 @@ class PeerRuntime:
 
     # ------------------------------------------------------------- main loop
 
+    def _intake_update(self, header: Dict, trees: Dict):
+        """The UPDATE intake seam, shared by the serial path (_handle, main
+        loop) and the pipelined intake thread: post-ack quarantine gate,
+        then into the leader's locked arrival buffer."""
+        src = int(header.get("from", -1))
+        if (self.rep is not None and src != self.peer_id
+                and self.rep.is_quarantined(src)):
+            # quarantine refusal is POST-ACK, like a partition-gate
+            # drop: the frame was delivered intact and the sender's
+            # failure detector must not read distrust as peer death
+            # (peer death != malice, and vice versa)
+            with self._qdrop_lock:
+                self.rep.quarantine_drops += 1
+            return
+        if self._leader() == self.peer_id:
+            self._buffer_push((header, trees, time.time()))
+        # an update addressed to a stale leader is dropped: the sender
+        # will rebase on the next global broadcast
+
+    def _intake_loop(self):
+        """Pipelined intake (cfg.dist.pipeline): drain the transport inbox
+        continuously — UPDATE frames go straight into the double-buffered
+        arrival buffer (so the listener/inbox never backs up behind a
+        merge), everything else routes to the control queue the main loop
+        drains. Protocol handlers stay single-threaded in the main loop;
+        only the buffer push crosses threads, under its lock."""
+        while not self._stop:
+            msg = self.transport.recv(timeout_s=0.05)
+            if msg is None:
+                continue
+            header, trees = msg
+            if header.get("type") == "update":
+                self._intake_update(header, trees)
+            else:
+                self._ctrl.put(msg)
+
+    def _next_ctrl(self, timeout_s: float):
+        """Next message for the MAIN loop: the control queue when the
+        intake thread owns the inbox, the inbox itself otherwise."""
+        if self._intake_thread is not None:
+            try:
+                return self._ctrl.get(timeout=timeout_s)
+            except queue.Empty:
+                return None
+        return self.transport.recv(timeout_s=timeout_s)
+
     def _handle(self, header: Dict, trees: Dict):
         kind = header.get("type")
         if kind == "update":
-            src = int(header.get("from", -1))
-            if (self.rep is not None and src != self.peer_id
-                    and self.rep.is_quarantined(src)):
-                # quarantine refusal is POST-ACK, like a partition-gate
-                # drop: the frame was delivered intact and the sender's
-                # failure detector must not read distrust as peer death
-                # (peer death != malice, and vice versa)
-                self.rep.quarantine_drops += 1
-                return
-            if self._leader() == self.peer_id:
-                self._buffer_push((header, trees, time.time()))
-            # an update addressed to a stale leader is dropped: the sender
-            # will rebase on the next global broadcast
+            # serial path only — with the pipeline on, updates were
+            # already consumed by the intake thread and never reach here
+            self._intake_update(header, trees)
         elif kind == "ping":
             pass  # liveness probe: delivery (the ack) was the answer
         elif kind == "global":
@@ -1264,6 +1341,12 @@ class PeerRuntime:
         except Exception as e:  # an eval failure must not eat the report
             logger.warning("peer %d: final eval failed (%s)", self.peer_id, e)
         self._final_eval = {"loss": loss, "acc": acc}
+        # drain the sender pipeline BEFORE the stop message: the final
+        # global broadcast rides the per-destination workers, and a sync
+        # shutdown racing past a queued broadcast would stop a follower
+        # one version short of the state it was owed
+        self.transport.flush_sends(
+            timeout_s=self.cfg.dist.send_deadline_s)
         for p in range(self.peers):
             if p == self.peer_id:
                 continue
@@ -1278,8 +1361,14 @@ class PeerRuntime:
                     self.version, " (resumed)" if self._resumed else "")
         telemetry.emit("run.start", role="peer", peers=self.peers,
                        resumed=self._resumed, version=int(self.version),
-                       epoch=self.transport.epoch)
+                       epoch=self.transport.epoch,
+                       pipeline=bool(self.cfg.dist.pipeline))
         self.transport.start()
+        if self.cfg.dist.pipeline:
+            self._intake_thread = threading.Thread(
+                target=self._intake_loop, daemon=True,
+                name=f"bcfl-dist-intake-{self.peer_id}")
+            self._intake_thread.start()
         # an immediate partial report: from this instant on, even a peer
         # SIGKILLed seconds into the run leaves evidence behind
         self._write_report(status="running")
@@ -1290,10 +1379,10 @@ class PeerRuntime:
             while not self._stop:
                 self._check_watchdogs()
                 self._maybe_flush_report()
-                msg = self.transport.recv(timeout_s=0.05)
+                msg = self._next_ctrl(timeout_s=0.05)
                 while msg is not None:
                     self._handle(*msg)
-                    msg = self.transport.recv(timeout_s=0.0)
+                    msg = self._next_ctrl(timeout_s=0.0)
                 if self._stop:
                     break
                 self._update_partition_state()
@@ -1325,6 +1414,10 @@ class PeerRuntime:
                 else:
                     time.sleep(0.05)  # drained; waiting for shutdown/merges
         finally:
+            # a short drain so a follower's last enqueued update isn't cut
+            # off mid-stream by close (post-shutdown frames are moot, but
+            # a half-written frame would show up as a receiver wire_drop)
+            self.transport.flush_sends(timeout_s=2.0)
             self.transport.close()
             self._deadline_timer.cancel()
         self._write_report(status="ok")

@@ -7,7 +7,18 @@ connections on the peer's own port and enqueues complete frames into a
 BOUNDED inbox; sends open a fresh connection per message (loopback
 connects are ~microseconds, and connection-per-message means a crashed
 receiver can never wedge a cached socket). Every operation runs under a
-hard deadline.
+hard deadline. Frames are STREAMED both ways (wire.write_frame /
+read_frame): the send path never concatenates a payload into one bytes,
+and the receive path decodes into preallocated arrays — peak
+serialization memory is the skeleton, not a second model copy.
+
+Two send seams share one reliable protocol (:meth:`_send_reliable`):
+:meth:`PeerTransport.send` blocks until delivered/budget-expired (control
+messages, probes, tests), and :meth:`send_async` — the comms/compute
+overlap seam — enqueues onto a bounded per-destination sender WORKER and
+returns, preserving per-destination msg-id order (allocation order ==
+enqueue order == FIFO wire order) with block-on-full back-pressure
+(``DistConfig.pipeline_depth``).
 
 The delivery contract (all of it lives here, so the runtime's handlers
 stay single-purpose):
@@ -65,13 +76,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 from bcfl_tpu.config import DistConfig
 from bcfl_tpu.telemetry import events as _telemetry
 from bcfl_tpu.dist.wire import (
-    PREFIX_LEN,
     CrcError,
     WireError,
-    pack_frame,
+    frame_prefix,
     read_ack,
     read_frame,
     write_ack,
+    write_frame,
 )
 from bcfl_tpu.faults import FaultPlan
 
@@ -219,24 +230,15 @@ class WireChaos:
         self.plan = plan if plan is not None else FaultPlan()
         self.clock_fn = clock_fn
 
-    def actions(self, src: int, dst: int, msg_id: int,
-                attempt: int) -> Optional[dict]:
-        return self.plan.wire_actions(int(self.clock_fn()), src, dst,
-                                      msg_id, attempt)
-
-
-def _flip_payload_bytes(frame: bytes, fracs) -> bytes:
-    """In-flight byte damage: XOR-flip payload bytes at the fraction-chosen
-    positions (past the magic/length/crc prefix, so the receiver sees a
-    well-framed message whose CRC no longer matches — the realistic
-    corruption the checksum exists for)."""
-    buf = bytearray(frame)
-    n = len(buf) - PREFIX_LEN
-    if n <= 0:
-        return frame
-    for f in fracs:
-        buf[PREFIX_LEN + min(int(f * n), n - 1)] ^= 0xFF
-    return bytes(buf)
+    def actions(self, src: int, dst: int, msg_id: int, attempt: int,
+                clock: Optional[int] = None) -> Optional[dict]:
+        """Fault draw for one attempt. ``clock`` pins the lane clock to a
+        caller-captured instant — the pipelined sender records it at
+        ENQUEUE time, so a message's fate stays a deterministic function
+        of (seed, round-it-was-produced, ids, attempt) no matter when the
+        worker actually transmits it."""
+        c = int(self.clock_fn()) if clock is None else int(clock)
+        return self.plan.wire_actions(c, src, dst, msg_id, attempt)
 
 
 class PeerTransport:
@@ -295,11 +297,27 @@ class PeerTransport:
         self._dedup_epoch: Dict[int, int] = {}
         self._dedup_lock = threading.Lock()
         # receive-path counters are bumped from concurrent per-connection
-        # serve threads: a plain += is a racy read-add-store there
+        # serve threads AND (with the pipeline on) the sender workers: a
+        # plain += is a racy read-add-store there
         self._stats_lock = threading.Lock()
         self._server: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._closing = threading.Event()
+        # --- pipelined sender (policy.pipeline; RUNTIME.md §4) ---
+        # one worker + bounded handoff queue per destination: send_async
+        # allocates the msg_id in the CALLER's thread (per-destination
+        # allocation order == enqueue order == wire order, since the
+        # worker drains FIFO) and returns immediately; the retry/backoff
+        # loop, chaos draws, and detector feeding all run in the worker.
+        # The bounded queue IS the back-pressure: a slow link blocks the
+        # enqueuing round loop after pipeline_depth frames instead of
+        # buffering model-sized trees without bound.
+        self._send_queues: Dict[int, "queue.Queue"] = {}
+        self._send_lock = threading.Lock()  # msg-id alloc + worker spawn
+        self._inflight = 0  # async sends enqueued or executing
+        self._inflight_cv = threading.Condition()
+        self.async_enqueued = 0     # logical sends handed to a worker
+        self.backpressure_blocks = 0  # enqueues that had to wait on a full queue
 
     def _bump(self, name: str) -> None:
         with self._stats_lock:
@@ -526,10 +544,12 @@ class PeerTransport:
     def alloc_msg_id(self, to: int) -> int:
         """Next monotone message id for destination ``to`` (the leader also
         draws ids for its own self-buffered updates, so every merged update
-        has a unique (from, msg_id) identity)."""
-        i = self._next_msg_id.get(to, 0)
-        self._next_msg_id[to] = i + 1
-        return i
+        has a unique (from, msg_id) identity). Thread-safe: the round loop
+        and the pipeline's enqueue path both allocate."""
+        with self._send_lock:
+            i = self._next_msg_id.get(to, 0)
+            self._next_msg_id[to] = i + 1
+            return i
 
     def send(self, to: int, header: Dict, trees: Optional[Dict] = None,
              timeout_s: Optional[float] = None) -> bool:
@@ -538,22 +558,152 @@ class PeerTransport:
         attempts with exponential backoff + deterministic jitter under the
         per-destination deadline budget (``timeout_s`` or
         ``policy.send_deadline_s``), feeding every attempt outcome to the
-        failure detector.
+        failure detector. BLOCKS until delivered or the budget expires;
+        :meth:`send_async` is the pipelined fire-and-track variant.
 
         Returns True once the destination acked one copy; False when the
         partition gate blocks the pair, the circuit is open (peer DOWN, no
         probe due), or the retry budget expired. It never raises on
         network failure — call sites need no per-call error handling; the
         :meth:`stats` counters and the detector carry the evidence."""
-        t_start = time.time()
         if self.gate is not None and not self.gate.allowed(self.peer_id, to):
             _telemetry.emit("send", to=to, type=header.get("type"),
                             ok=False, reason="gate", msg_id=None)
             return False
-        if not self.detector.allow(to):
-            self.circuit_skips += 1
+        msg_id = self.alloc_msg_id(to)
+        header = dict(header, **{"from": self.peer_id, "msg_id": msg_id,
+                                 "msg_epoch": self.epoch})
+        return self._send_reliable(to, header, trees, timeout_s,
+                                   time.time())
+
+    # ------------------------------------------------- pipelined sender
+
+    def send_async(self, to: int, header: Dict,
+                   trees: Optional[Dict] = None,
+                   timeout_s: Optional[float] = None) -> bool:
+        """Enqueue one logical send on the per-destination sender worker
+        and return immediately — the comms/compute overlap seam
+        (RUNTIME.md §4): the round loop hands the frame off and starts the
+        next local round while the worker runs the whole reliable-send
+        protocol (retry/backoff/jitter, chaos draws, detector feeding,
+        telemetry) in the background.
+
+        Ordering and identity are exactly the synchronous seam's: the
+        ``msg_id`` is allocated HERE in the caller's thread (so
+        per-destination allocation order is enqueue order) and the worker
+        drains its queue FIFO, so frames to one destination hit the wire
+        in msg-id order. The handoff queue is bounded
+        (``policy.pipeline_depth``): when the destination is slower than
+        the round loop, the enqueue BLOCKS — back-pressure, so a dead or
+        slow link can never buffer unbounded model-sized frames.
+
+        Returns True when the frame was enqueued (the delivery outcome is
+        reported through the detector/stats/event stream, like every
+        at-least-once send); False when the partition gate blocks the pair
+        at enqueue time or the transport is closing."""
+        if self.gate is not None and not self.gate.allowed(self.peer_id, to):
             _telemetry.emit("send", to=to, type=header.get("type"),
-                            ok=False, reason="circuit_open", msg_id=None)
+                            ok=False, reason="gate", msg_id=None)
+            return False
+        with self._send_lock:
+            q = self._send_queues.get(to)
+            if q is None:
+                q = queue.Queue(maxsize=max(1, self.policy.pipeline_depth))
+                self._send_queues[to] = q
+                t = threading.Thread(
+                    target=self._sender_loop, args=(to, q), daemon=True,
+                    name=f"bcfl-dist-send-{self.peer_id}-{to}")
+                t.start()
+                self._threads.append(t)
+            i = self._next_msg_id.get(to, 0)
+            self._next_msg_id[to] = i + 1
+        # pin the chaos lane clock NOW: the message's fault fate must be a
+        # deterministic function of the round that PRODUCED it, not of
+        # when the worker happens to transmit it
+        chaos_clock = (int(self.chaos.clock_fn())
+                       if self.chaos is not None else None)
+        item = (dict(header, **{"from": self.peer_id, "msg_id": i,
+                                "msg_epoch": self.epoch}),
+                trees, timeout_s, time.time(), chaos_clock)
+        with self._inflight_cv:
+            self._inflight += 1
+        blocked = q.full()  # the enqueue is about to wait on the bound
+        while not self._closing.is_set():
+            try:
+                # deadline: bounded handoff — block-on-full IS the
+                # back-pressure contract; each wait re-checks closing
+                q.put(item, timeout=0.25)
+                with self._stats_lock:
+                    self.async_enqueued += 1
+                    if blocked:
+                        self.backpressure_blocks += 1
+                return True
+            except queue.Full:
+                blocked = True
+        with self._inflight_cv:
+            self._inflight -= 1
+            self._inflight_cv.notify_all()
+        return False
+
+    def _sender_loop(self, to: int, q: "queue.Queue") -> None:
+        """One destination's sender worker: drain the bounded queue FIFO,
+        running the full reliable-send protocol per frame. Exits when the
+        transport closes and the queue is drained."""
+        while True:
+            try:
+                item = q.get(timeout=0.25)
+            except queue.Empty:
+                if self._closing.is_set():
+                    return
+                continue
+            header, trees, timeout_s, t_start, chaos_clock = item
+            try:
+                self._send_reliable(to, header, trees, timeout_s, t_start,
+                                    chaos_clock=chaos_clock)
+            except Exception:  # noqa: BLE001 — a worker must never die
+                logger.exception("peer %d: sender worker to %d failed",
+                                 self.peer_id, to)
+            finally:
+                with self._inflight_cv:
+                    self._inflight -= 1
+                    self._inflight_cv.notify_all()
+
+    def flush_sends(self, timeout_s: float = 30.0) -> bool:
+        """Block until every async send has completed its protocol (queue
+        drained AND workers idle), or ``timeout_s``. The runtime calls
+        this before broadcasting shutdown (so a queued final update or
+        global can't race the stop message) and before closing."""
+        deadline = time.monotonic() + timeout_s
+        with self._inflight_cv:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._inflight_cv.wait(min(left, 0.25))
+        return True
+
+    def _send_reliable(self, to: int, header: Dict, trees: Optional[Dict],
+                       timeout_s: Optional[float], t_start: float,
+                       chaos_clock: Optional[int] = None) -> bool:
+        """The reliable-send protocol shared by the sync seam and the
+        sender workers: circuit check, probe budgeting, retry loop with
+        chaos draws, detector feeding, telemetry. ``header`` arrives
+        already stamped with (from, msg_id, msg_epoch). Thread-safe: all
+        counters go through the stats lock."""
+        msg_id = header["msg_id"]
+        if (self.gate is not None
+                and not self.gate.allowed(self.peer_id, to)):
+            # the async path re-checks at EXECUTION time: a partition span
+            # can open between enqueue and dequeue, and skipping the
+            # attempt keeps a blocked pair from burning its retry budget
+            # (the receiver's own gate is still authoritative)
+            _telemetry.emit("send", to=to, type=header.get("type"),
+                            ok=False, reason="gate", msg_id=msg_id)
+            return False
+        if not self.detector.allow(to):
+            self._bump("circuit_skips")
+            _telemetry.emit("send", to=to, type=header.get("type"),
+                            ok=False, reason="circuit_open", msg_id=msg_id)
             return False
         # a granted probe of a DOWN peer is a SINGLE attempt under a
         # probe-interval-bounded budget: a BLACK-HOLING corpse (SYNs
@@ -564,9 +714,6 @@ class PeerTransport:
         # probing
         state = self.detector.state_of(to)
         probe = state == DOWN
-        msg_id = self.alloc_msg_id(to)
-        header = dict(header, **{"from": self.peer_id, "msg_id": msg_id,
-                                 "msg_epoch": self.epoch})
         pol = self.policy
         budget_s = timeout_s if timeout_s is not None else pol.send_deadline_s
         if probe:
@@ -584,16 +731,21 @@ class PeerTransport:
             # link, the transient is bounded, starvation would not be
             budget_s = min(budget_s, pol.probe_interval_s)
         deadline = time.monotonic() + budget_s
-        # serialize ONCE per logical send: a retry of an unchanged frame
-        # (the common case — only chaos reorder mutates the header) must
-        # not re-pack a potentially multi-hundred-MB model tree per attempt
-        frame = pack_frame(header, trees)
+        # CRC ONCE per logical send: the prefix pass walks the leaf
+        # buffers zero-copy; re-attempts of an unchanged frame (the common
+        # case — only chaos reorder mutates the header) reuse it instead
+        # of re-checksumming a potentially multi-hundred-MB tree. The
+        # frame itself is never materialized — attempts stream straight
+        # from the numpy buffers (wire.write_frame).
+        prefix = frame_prefix(header, trees)
+        nbytes = len(prefix) + int.from_bytes(prefix[4:12], "little")
         attempt = 0
         while True:
-            acts = (self.chaos.actions(self.peer_id, to, msg_id, attempt)
+            acts = (self.chaos.actions(self.peer_id, to, msg_id, attempt,
+                                       clock=chaos_clock)
                     if self.chaos is not None else None)
             try:
-                self._attempt(to, header, trees, frame, acts, deadline)
+                self._attempt(to, header, trees, prefix, acts, deadline)
                 self.detector.on_success(to)
                 # stamped with the send's START instant (t_wall=t_start):
                 # the causal timeline needs the send to precede the recv
@@ -601,7 +753,7 @@ class PeerTransport:
                 _telemetry.emit(
                     "send", to=to, type=header.get("type"), ok=True,
                     msg_id=msg_id, msg_epoch=self.epoch,
-                    attempts=attempt + 1, bytes=len(frame),
+                    attempts=attempt + 1, bytes=nbytes,
                     wall_s=time.time() - t_start, t_wall=t_start)
                 return True
             except TransportError as e:
@@ -627,7 +779,7 @@ class PeerTransport:
                     outcome=str(e)[:200])
                 if (probe or attempt > pol.send_retries
                         or time.monotonic() + backoff >= deadline):
-                    self.send_failures += 1
+                    self._bump("send_failures")
                     _telemetry.emit(
                         "send", to=to, type=header.get("type"), ok=False,
                         msg_id=msg_id, msg_epoch=self.epoch,
@@ -643,24 +795,26 @@ class PeerTransport:
                         "attempt(s): %s", self.peer_id, to,
                         header.get("type"), msg_id, attempt, e)
                     return False
-                self.retries += 1
+                self._bump("retries")
                 logger.debug("peer %d -> %d: attempt %d failed (%s); "
                              "retrying in %.2fs", self.peer_id, to,
                              attempt, e, backoff)
                 time.sleep(backoff)
 
     def _attempt(self, to: int, header: Dict, trees: Optional[Dict],
-                 frame: bytes, acts: Optional[dict],
+                 prefix: bytes, acts: Optional[dict],
                  deadline: float) -> None:
-        """One transmission attempt: chaos injection, connect, frame, ack.
-        ``frame`` is the pre-packed clean frame; only the chaos reorder
-        path (header mutation) re-packs. Raises :class:`TransportError`
-        on any failure."""
+        """One transmission attempt: chaos injection, connect, stream the
+        frame, ack. ``prefix`` is the pre-computed clean frame prefix
+        (magic + length + CRC); only the chaos reorder path (header
+        mutation) recomputes it. Raises :class:`TransportError` on any
+        failure."""
         def _chaos(action: str, **extra) -> None:
             # per-injection events: high-rate under an armed lane, so
             # routed through the sampling knob; the lane/draw/target
             # coordinates make every injection replayable from the stream
-            self.chaos_injected[action] += 1
+            with self._stats_lock:
+                self.chaos_injected[action] += 1
             _telemetry.emit_sampled(
                 "chaos", (to, header.get("msg_id"), action),
                 lane="wire", action=action, dst=to,
@@ -672,12 +826,12 @@ class PeerTransport:
                            max(deadline - time.monotonic(), 0.0)))
         if acts is not None and acts["reorder_s"] > 0:
             _chaos("reorder", hold_s=acts["reorder_s"])
-            frame = pack_frame(dict(header, chaos_hold_s=acts["reorder_s"]),
-                               trees)
-        on_wire = frame
-        if acts is not None and acts["corrupt"]:
+            header = dict(header, chaos_hold_s=acts["reorder_s"])
+            prefix = frame_prefix(header, trees)
+        corrupt = (acts["corrupt_pos"]
+                   if acts is not None and acts["corrupt"] else None)
+        if corrupt:
             _chaos("corrupt")
-            on_wire = _flip_payload_bytes(frame, acts["corrupt_pos"])
         if acts is not None and acts["drop"]:
             # the frame vanishes in the network: the receiver never sees
             # it and the sender learns only via the missing ack — modeled
@@ -686,24 +840,27 @@ class PeerTransport:
             raise TransportError(
                 f"chaos wire lane dropped msg {header['msg_id']} "
                 f"-> peer {to}")
-        self._deliver(to, on_wire, deadline)
+        self._deliver(to, header, trees, prefix, corrupt, deadline)
         if acts is not None and acts["dup"]:
-            # a duplicated delivery: second copy of the same on-wire
-            # bytes, best-effort, bounded by the SAME deadline budget as
-            # the main attempt — a stalling receiver must not hold the
-            # peer loop past the send's wall budget. The receiver's dedup
+            # a duplicated delivery: second CLEAN copy of the same frame,
+            # best-effort, bounded by the SAME deadline budget as the
+            # main attempt — a stalling receiver must not hold the
+            # sender past the send's wall budget. The receiver's dedup
             # window is what must absorb the copy.
             _chaos("dup")
             try:
-                self._deliver(to, frame, deadline)
+                self._deliver(to, header, trees, prefix, None, deadline)
             except TransportError:
                 pass
 
-    def _deliver(self, to: int, on_wire: bytes, deadline: float) -> None:
-        """One physical delivery: connect, write the frame bytes, read
-        the ack — the single handshake both the real attempt and the
-        chaos duplicate go through, every socket op capped by the
-        remaining deadline budget. Raises :class:`TransportError`."""
+    def _deliver(self, to: int, header: Dict, trees: Optional[Dict],
+                 prefix: bytes, corrupt: Optional[list],
+                 deadline: float) -> None:
+        """One physical delivery: connect, STREAM the frame straight from
+        the numpy leaf buffers (wire.write_frame — the payload is never
+        concatenated), read the ack — the single handshake both the real
+        attempt and the chaos duplicate go through, every socket op capped
+        by the remaining deadline budget. Raises :class:`TransportError`."""
         budget = deadline - time.monotonic()
         if budget <= 0:
             raise TransportError(f"send deadline budget exhausted "
@@ -714,7 +871,8 @@ class PeerTransport:
                     (host, port),
                     timeout=min(self.connect_timeout_s, budget)) as sock:
                 sock.settimeout(min(self.io_timeout_s, budget))
-                sock.sendall(on_wire)
+                write_frame(sock, header, trees, corrupt_frac=corrupt,
+                            prefix=prefix)
                 read_ack(sock, timeout_s=min(self.io_timeout_s, budget))
         except (OSError, socket.timeout, WireError) as e:
             raise TransportError(
@@ -735,6 +893,11 @@ class PeerTransport:
             "reorders_held": self.reorders_held,
             "circuit_skips": self.circuit_skips,
             "dropped_by_gate": self.dropped_by_gate,
+            "pipeline": {
+                "async_enqueued": self.async_enqueued,
+                "backpressure_blocks": self.backpressure_blocks,
+                "workers": len(self._send_queues),
+            },
             "chaos_injected": dict(self.chaos_injected),
             "detector": {
                 "states": {str(p): s

@@ -73,6 +73,19 @@ def main(argv=None):
                          "reachable fraction of the component the leader "
                          "stops advancing the global (default 0.5; "
                          "RUNTIME.md 'Delivery contract')")
+    ap.add_argument("--no-dist-pipeline", action="store_true",
+                    help="disable the comms/compute overlap pipeline for "
+                         "--runtime dist (per-destination sender workers + "
+                         "double-buffered merge intake, on by default — "
+                         "RUNTIME.md §4); the serial PR 7-10 loop is the "
+                         "wire_perf.py A/B baseline")
+    ap.add_argument("--dist-pipeline-depth", type=int, default=None,
+                    metavar="N",
+                    help="bounded per-destination handoff queue for the "
+                         "pipelined sender (default 2): a slow link blocks "
+                         "the round loop after N queued frames "
+                         "(back-pressure) instead of buffering unbounded "
+                         "model-sized trees")
     ap.add_argument("--task", choices=["classification", "causal_lm"],
                     default=None,
                     help="causal_lm = federated next-token fine-tuning "
@@ -613,6 +626,12 @@ def main(argv=None):
         raise SystemExit("--dist-quorum only applies to --runtime dist")
     if args.dist_buffer is not None and args.runtime != "dist":
         raise SystemExit("--dist-buffer only applies to --runtime dist")
+    if args.no_dist_pipeline and args.runtime != "dist":
+        raise SystemExit("--no-dist-pipeline only applies to "
+                         "--runtime dist")
+    if args.dist_pipeline_depth is not None and args.runtime != "dist":
+        raise SystemExit("--dist-pipeline-depth only applies to "
+                         "--runtime dist")
     if args.runtime is not None:
         # runtime joins the ONE combined replace below: applying sync/mode/
         # faults first with runtime still "local" would run the local-
@@ -631,6 +650,10 @@ def main(argv=None):
                 dist_kw["quorum_frac"] = args.dist_quorum
             if args.dist_buffer is not None:
                 dist_kw["buffer"] = args.dist_buffer
+            if args.no_dist_pipeline:
+                dist_kw["pipeline"] = False
+            if args.dist_pipeline_depth is not None:
+                dist_kw["pipeline_depth"] = args.dist_pipeline_depth
             overrides["dist"] = dataclasses.replace(cfg.dist, **dist_kw)
     cfg = cfg.replace(**overrides)
 

@@ -32,6 +32,15 @@ BCFL_BENCH_PLATFORM=<platform> redirects the backend via jax.config (the
 JAX_PLATFORMS env var is overridden by site hooks on some hosts);
 BCFL_BENCH_MODE=serverless times the fused gossip program (gossip_rounds —
 per-client params held in HBM across the block) instead of server FedAvg.
+BCFL_BENCH_MODE=dist times the REAL multi-process async P2P runtime
+(RUNTIME.md) on loopback: BCFL_BENCH_PEERS peer OS processes co-train to a
+target version count and the row reports end-to-end federated throughput
+(samples/sec across the fleet, from the per-peer reports) — the first
+measured dist row (ROADMAP "Hot-path speed"). Dist knobs:
+BCFL_BENCH_PEERS (default 3), BCFL_BENCH_DIST_ROUNDS (target versions,
+default 6), BCFL_BENCH_DIST_MODEL (default tiny-bert — peers each compile
+their own engine), BCFL_BENCH_DIST_PIPELINE=0 disables the comms/compute
+overlap pipeline (the A/B axis scripts/wire_perf.py sweeps).
 BCFL_BENCH_COMPRESS={none,int8,topk,int8+topk} compiles the update-exchange
 codec (COMPRESSION.md) into the timed round program and adds bytes-on-wire
 fields to the JSON line — the throughput-per-codec axis of the
@@ -112,6 +121,8 @@ def _emit(obj):
 
 
 def _metric_name():
+    if MODE == "dist":
+        return "dist_fed_async_samples_per_sec"
     tag = "serverless_" if MODE == "serverless" else ""
     return f"bert-base_fed_{tag}finetune_samples_per_sec_per_chip"
 
@@ -216,13 +227,91 @@ class _Watchdog:
             self._timer.cancel()
 
 
+def _dist_bench(watchdog):
+    """The runtime='dist' BENCH row: a real multi-peer loopback federation
+    timed end to end (spawn -> target version count -> reports), reported
+    as fleet samples/sec. Runs AFTER the preflight proved the backend
+    alive, so a wedge is still stamped backend_init_ok=false upstream."""
+    import shutil
+    import tempfile
+
+    from bcfl_tpu.compression import CompressionConfig
+    from bcfl_tpu.config import DistConfig, FedConfig, LedgerConfig, \
+        PartitionConfig
+    from bcfl_tpu.dist.harness import run_dist
+
+    peers = int(os.environ.get("BCFL_BENCH_PEERS", "3"))
+    versions = int(os.environ.get("BCFL_BENCH_DIST_ROUNDS", "6"))
+    model = os.environ.get("BCFL_BENCH_DIST_MODEL", "tiny-bert")
+    clients_per_peer = int(os.environ.get("BCFL_BENCH_DIST_CLIENTS", "2"))
+    pipeline = os.environ.get("BCFL_BENCH_DIST_PIPELINE", "1") != "0"
+    batch, seq, local_batches = 4, 16, 2
+    deadline = float(os.environ.get("BCFL_BENCH_DIST_DEADLINE_S", "420"))
+    cfg = FedConfig(
+        name="bench_dist", runtime="dist", mode="server", sync="async",
+        model=model, dataset="synthetic",
+        num_clients=peers * clients_per_peer, num_rounds=versions,
+        seq_len=seq, batch_size=batch, max_local_batches=local_batches,
+        eval_every=0, seed=42,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        compression=CompressionConfig(kind=COMPRESS),
+        dist=DistConfig(peers=peers, peer_deadline_s=deadline,
+                        pipeline=pipeline),
+    )
+    run_dir = tempfile.mkdtemp(prefix="bcfl_bench_dist_")
+    watchdog.stage("dist-run", deadline + 120.0)
+    t0 = time.perf_counter()
+    result = run_dist(cfg, run_dir, deadline_s=deadline + 60.0,
+                      platform=os.environ.get("BCFL_BENCH_PLATFORM"))
+    dt = time.perf_counter() - t0
+    reports = result["reports"]
+    if not result["ok"] or len(reports) != peers:
+        raise RuntimeError(
+            f"dist bench run failed: rcs={result['returncodes']} "
+            f"reports={sorted(reports)} (logs under {run_dir})")
+    # fleet throughput: every peer's local rounds each fine-tune its
+    # whole client slice for local_batches batches
+    total_rounds = sum(r["local_rounds"] for r in reports.values())
+    samples = total_rounds * clients_per_peer * local_batches * batch
+    streams = result.get("event_streams") or []
+    keep = os.environ.get("BCFL_BENCH_DIST_KEEP_RUN") == "1"
+    out = {
+        "metric": _metric_name(),
+        "value": round(samples / dt, 2),
+        "unit": "samples/sec (fleet)",
+        "vs_baseline": round(samples / dt / BASELINE_SAMPLES_PER_SEC, 2),
+        "backend_init_ok": _BACKEND_INIT_OK,
+        # the peers streamed telemetry into the run dir; the path only
+        # outlives this row under KEEP_RUN (else it is cleaned up with
+        # the run and stamped as such — never a dangling path)
+        "event_stream": (os.path.dirname(streams[0]) if streams and keep
+                         else ("discarded (BCFL_BENCH_DIST_KEEP_RUN=1 "
+                               "retains)" if streams else "disabled")),
+        "peers": peers,
+        "model": model,
+        "pipeline": pipeline,
+        "compress": COMPRESS,
+        "target_versions": versions,
+        "final_versions": {str(p): r.get("final_version")
+                           for p, r in reports.items()},
+        "local_rounds_total": int(total_rounds),
+        "wall_s": round(dt, 2),
+    }
+    if keep:
+        out["run_dir"] = run_dir
+    else:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return out
+
+
 def main():
     watchdog = _Watchdog(STAGE_TIMEOUT_S)
-    if MODE not in ("server", "serverless"):
+    if MODE not in ("server", "serverless", "dist"):
         # fail fast: a typo'd mode silently timing the wrong program would
         # be a multi-hour TPU run of worthless evidence
         _error_json("config", f"unknown BCFL_BENCH_MODE {MODE!r}; "
-                    "expected 'server' or 'serverless'")
+                    "expected 'server', 'serverless', or 'dist'")
         sys.exit(1)
     if COMPRESS not in COMPRESS_KINDS:
         # same fail-fast class: a typo'd codec would silently time the
@@ -269,6 +358,14 @@ def main():
         if int(probe.sum()) != 120:
             raise RuntimeError(f"preflight readback mismatch: {probe!r}")
         _BACKEND_INIT_OK = True
+
+        if MODE == "dist":
+            # the dist row spawns its own peer processes; the parent's
+            # backend just proved alive, which is all the row inherits
+            out = _dist_bench(watchdog)
+            watchdog.cancel()
+            _emit(out)
+            return
 
         if TELEMETRY_DIR:
             from bcfl_tpu import telemetry

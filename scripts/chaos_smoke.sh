@@ -179,6 +179,49 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# Wire-perf leg (PERF.md "Comms/compute overlap", RUNTIME.md §3-4): the
+# r11 streaming wire + pipelined sender in sanity mode — the zero-copy
+# serialization gate (stream writer peak alloc bounded, no full-payload
+# concatenation), a pipeline-ON 2-peer loopback run under the wire-delay
+# chaos lane completing with nonzero async-send counters, and the full
+# delivery-contract invariant suite clean over its event streams. The
+# measured A/B (pipeline on vs off per-round wall ratio) is the full
+# scripts/wire_perf.py run -> results/wire_perf.json.
+echo
+echo "wire-perf leg: streaming wire + pipelined sender, sanity gates"
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/wire_perf.py --sanity --peers 2 --rounds 5 \
+    --deadline 400 --idle-timeout 90 \
+    --run-dir /tmp/bcfl_chaos_wire_perf \
+    --out /tmp/bcfl_chaos_wire_perf.json
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+# ...and prove the on-disk streams alone carry the evidence: bcfl-tpu
+# trace over the pipeline-on run must reproduce zero violations standalone
+WIRE_PERF_RUN_DIR=$(python -c "import json; print(json.load(open(
+    '/tmp/bcfl_chaos_wire_perf.json'))['pipeline_ab']['pipeline_on']['run_dir'])")
+timeout -k 10 120 python -m bcfl_tpu.entrypoints trace "$WIRE_PERF_RUN_DIR" \
+    > /tmp/bcfl_chaos_wire_perf_trace.json
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "wire-perf collator FAILED (rc=$rc); see /tmp/bcfl_chaos_wire_perf_trace.json" >&2
+  exit "$rc"
+fi
+python -c "
+import json
+d = json.load(open('/tmp/bcfl_chaos_wire_perf_trace.json'))
+w = json.load(open('/tmp/bcfl_chaos_wire_perf.json'))
+counters = w['pipeline_ab']['pipeline_on']['pipeline_counters']
+print('wire-perf: stream alloc %.2f%% of payload, pipeline counters %s, '
+      'invariants %s' % (
+    100 * w['serialization_alloc']['stream_writer_peak_frac_of_payload'],
+    {p: (c or {}).get('async_enqueued') for p, c in counters.items()},
+    'CLEAN' if d['ok'] else 'VIOLATED'))
+assert d['ok'], 'invariant violation in wire-perf streams'
+"
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Collator leg (OBSERVABILITY.md): re-run `bcfl-tpu trace` standalone over
 # the wire-chaos run's per-peer event streams — merges them into one
 # causally-ordered timeline and FAILS on any delivery-contract invariant

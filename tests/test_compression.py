@@ -160,6 +160,100 @@ def test_payload_bytes_accounting_and_corruption():
                               np.asarray(hit[name]["s"][0]))
 
 
+# ------------------------------------------------------- fused encode path
+
+
+@pytest.mark.parametrize("kind", ["int8", "topk", "int8+topk"])
+@pytest.mark.parametrize("stochastic", [False, True])
+def test_fused_encode_bit_identical_vs_unfused(kind, stochastic):
+    """The fused encode (leaves grouped into one concatenated int8 chunk-
+    grid quantize + one stacked top_k per distinct width) must produce
+    BIT-identical payloads to the per-leaf reference encode — chunk
+    groupings, per-leaf stochastic-rounding keys, and top-k row
+    independence are all preserved, so ledger digests and checkpointed
+    EF state cannot move."""
+    from bcfl_tpu.compression.codecs import encode_tree_unfused
+
+    comp = CompressionConfig(kind=kind, chunk=16, topk_frac=0.3,
+                             stochastic=stochastic)
+    # repeated shapes (the transformer case the grouping exists for) plus
+    # odd widths, so every grouping branch is exercised
+    k = jax.random.key(11)
+    tree = {
+        "l0": {"w": jax.random.normal(jax.random.fold_in(k, 1), (4, 37, 5)),
+               "b": jax.random.normal(jax.random.fold_in(k, 2), (4, 9))},
+        "l1": {"w": jax.random.normal(jax.random.fold_in(k, 3), (4, 37, 5)),
+               "b": jax.random.normal(jax.random.fold_in(k, 4), (4, 9))},
+        "head": jax.random.normal(jax.random.fold_in(k, 5), (4, 13)),
+    }
+    a = encode_tree_unfused(comp, tree, jax.random.key(7))
+    b = encode_tree(comp, tree, jax.random.key(7))
+    assert (jax.tree_util.tree_structure(a)
+            == jax.tree_util.tree_structure(b))
+    for (pa, xa), (pb, xb) in zip(
+            jax.tree_util.tree_flatten_with_path(a)[0],
+            jax.tree_util.tree_flatten_with_path(b)[0]):
+        assert np.asarray(xa).dtype == np.asarray(xb).dtype, pa
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb),
+                                      err_msg=str(pa))
+    # and the fused payload decodes against the same template
+    dec = decode_tree(comp, b, tree)
+    assert (jax.tree_util.tree_structure(dec)
+            == jax.tree_util.tree_structure(tree))
+
+
+def test_fused_encode_collapses_per_leaf_dispatches():
+    """The fusion is real, not a relabel: a tree with L leaves across D
+    distinct flattened widths lowers to exactly D top_k ops (not L) and
+    one int8 quantize pipeline (one concatenated max-reduce), where the
+    per-leaf reference encode lowers one per leaf."""
+    from bcfl_tpu.compression.codecs import encode_tree_unfused
+
+    comp = CompressionConfig(kind="int8+topk", topk_frac=0.3)
+    k = jax.random.key(0)
+    tree = {f"l{i}": jax.random.normal(jax.random.fold_in(k, i), (2, 50))
+            for i in range(4)}
+    tree["odd"] = jax.random.normal(jax.random.fold_in(k, 9), (2, 31))
+
+    def count(fn, prim):
+        jaxpr = jax.make_jaxpr(fn)(tree, jax.random.key(0))
+        return sum(1 for e in jaxpr.jaxpr.eqns if e.primitive.name == prim)
+
+    fused = count(lambda t, kk: encode_tree(comp, t, kk), "top_k")
+    unfused = count(lambda t, kk: encode_tree_unfused(comp, t, kk),
+                    "top_k")
+    assert unfused == 5  # one per leaf
+    assert fused == 2    # one per distinct width (50, 31)
+
+
+def test_fused_encode_zero_retraces_in_engine():
+    """The grouped encode keeps every shape trace-time static: the dist-
+    style split-phase async encoder (the seam the dist wire rides) traces
+    once across rounds. (The in-graph fused-program pin is
+    test_compressed_run_zero_retraces below.)"""
+    from bcfl_tpu.core.mesh import client_mesh
+    from bcfl_tpu.fed.client_step import build_programs
+    from bcfl_tpu.models import build
+
+    mesh = client_mesh(4)
+    model = build("tiny-bert", num_labels=2, vocab_size=512)
+    progs = build_programs(model, mesh, compression=INT8_TOPK)
+    import jax.numpy as jnp
+
+    tmpl = jax.jit(lambda key: model.init(
+        key, jnp.ones((2, 16), jnp.int32),
+        jnp.ones((2, 16), jnp.int32))["params"])(jax.random.key(0))
+    stack = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (4,) + x.shape), tmpl)
+    resid = progs.ef_init(tmpl)
+    rngs = jax.random.key_data(jax.vmap(jax.random.key)(
+        jnp.arange(4, dtype=jnp.uint32)))
+    n0 = progs.encode_deltas_async._cache_size()
+    for _ in range(3):
+        _, resid = progs.encode_deltas_async(stack, stack, resid, rngs)
+    assert progs.encode_deltas_async._cache_size() == n0 + 1
+
+
 # ------------------------------------------------------- program cache keys
 
 

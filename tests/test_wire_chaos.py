@@ -33,10 +33,12 @@ from bcfl_tpu.dist.wire import (
     PREFIX_LEN,
     CrcError,
     WireError,
+    frame_prefix,
     pack_frame,
     read_frame,
     unpack_frame,
     unpack_tree,
+    write_frame,
 )
 from bcfl_tpu.faults import FaultPlan
 
@@ -133,6 +135,9 @@ def test_fuzz_mid_tree_truncation():
     b'{"not": "a list"}',
     b'[42]',
     b'[{"path": "x", "dtype": "<f8", "shape": [99999999, 99999999]}]',
+    # dim past int64: np.prod raises OverflowError, which must classify
+    # as WireError, not kill the serve thread (r11 review catch)
+    b'[{"path": "x", "dtype": "<f8", "shape": [18446744073709551616]}]',
 ])
 def test_fuzz_hostile_tree_index_rows(index):
     with pytest.raises(WireError):
@@ -147,6 +152,194 @@ def test_fuzz_truncated_frame_payload_everywhere():
     for cut in range(len(payload)):
         with pytest.raises(WireError):
             unpack_frame(payload[:cut])
+
+
+# ------------------------------------------------- streaming wire (r11)
+
+
+def _capture_stream(write_fn) -> bytes:
+    """Run ``write_fn(sock)`` against a socketpair and return every byte
+    it wrote."""
+    a, b = socket.socketpair()
+    buf = bytearray()
+    done = threading.Event()
+
+    def rd():
+        b.settimeout(5.0)
+        try:
+            while True:
+                c = b.recv(1 << 16)
+                if not c:
+                    break
+                buf.extend(c)
+        except OSError:
+            pass
+        done.set()
+
+    t = threading.Thread(target=rd, daemon=True)
+    t.start()
+    try:
+        write_fn(a)
+    finally:
+        a.close()
+    done.wait(6.0)
+    b.close()
+    return bytes(buf)
+
+
+def _feed(raw: bytes):
+    """A socket delivering exactly ``raw`` then EOF (for read_frame)."""
+    a, b = socket.socketpair()
+
+    def wr():
+        try:
+            a.sendall(raw)
+        except OSError:
+            pass
+        a.close()
+
+    t = threading.Thread(target=wr, daemon=True)
+    t.start()
+    return b
+
+
+_STREAM_TREES = {
+    "t": {"a": {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "y": np.float64(3.5)},
+          "b": np.arange(7, dtype=np.int8)},
+    "u": {"z": np.ones((2, 2), np.float32)},
+}
+_STREAM_HDR = {"type": "update", "from": 1, "msg_id": 5}
+
+
+def test_streamed_frame_bytes_identical():
+    """write_frame (chunked, zero-copy, incremental CRC) must put the
+    EXACT same bytes on the wire as the in-memory reference pack_frame —
+    the on-wire layout is unchanged, so ledger digests, dedup ids, and
+    every PR 8 contract hold."""
+    ref = pack_frame(_STREAM_HDR, _STREAM_TREES)
+    got = _capture_stream(
+        lambda s: write_frame(s, _STREAM_HDR, _STREAM_TREES))
+    assert got == ref
+    # and the prefix helper (the retry loop's one-CRC-per-logical-send
+    # seam) agrees with the reference prefix
+    assert frame_prefix(_STREAM_HDR, _STREAM_TREES) == ref[:PREFIX_LEN]
+    # a reused prefix skips the CRC pass but streams the same bytes
+    got2 = _capture_stream(
+        lambda s: write_frame(s, _STREAM_HDR, _STREAM_TREES,
+                              prefix=ref[:PREFIX_LEN]))
+    assert got2 == ref
+
+
+def test_streaming_reader_roundtrips_reference_frame():
+    ref = pack_frame(_STREAM_HDR, _STREAM_TREES)
+    sock = _feed(ref)
+    try:
+        header, trees = read_frame(sock, timeout_s=5.0)
+    finally:
+        sock.close()
+    assert header == _STREAM_HDR
+    np.testing.assert_array_equal(trees["t"]["a"]["x"],
+                                  _STREAM_TREES["t"]["a"]["x"])
+    y = trees["t"]["a"]["y"]
+    # the wire has ALWAYS promoted 0-d scalars to (1,) (pack_tree's
+    # ascontiguousarray) — the streaming reader reproduces that exactly
+    assert y.shape == (1,) and y.dtype == np.float64 and float(y[0]) == 3.5
+    np.testing.assert_array_equal(trees["u"]["z"],
+                                  _STREAM_TREES["u"]["z"])
+
+
+def test_streaming_reader_truncation_at_every_chunk_boundary():
+    """Cut the byte stream at EVERY offset of a valid frame: the
+    streaming reader must raise a clean WireError (or classify to
+    CrcError) well inside its deadline — never a hang, never a partial
+    tree returned."""
+    frame = pack_frame({"n": 1}, {"t": {"x": np.int8([1, 2, 3]),
+                                        "y": np.float32([1.5])}})
+    for cut in range(len(frame)):
+        sock = _feed(frame[:cut])
+        t0 = time.time()
+        try:
+            with pytest.raises(WireError):
+                read_frame(sock, timeout_s=2.0)
+            assert time.time() - t0 < 3.0, f"cut {cut} overran deadline"
+        finally:
+            sock.close()
+
+
+def test_streamed_crc_classification_everywhere():
+    """Flip ONE payload byte at every offset: the streaming reader parses
+    before the whole-frame CRC can be known, so it must classify parse
+    failures by draining + finishing the CRC — in-flight damage is ALWAYS
+    a CrcError (crc_drops, the retry-healable counter), wherever the flip
+    lands (header JSON, length word, index, body)."""
+    frame = bytearray(pack_frame(_STREAM_HDR, {"t": {"x": np.float32(
+        [1, 2, 3, 4])}}))
+    for pos in range(PREFIX_LEN, len(frame)):
+        bad = bytearray(frame)
+        bad[pos] ^= 0xFF
+        sock = _feed(bytes(bad))
+        try:
+            with pytest.raises(CrcError):
+                read_frame(sock, timeout_s=2.0)
+        finally:
+            sock.close()
+
+
+def test_streaming_reader_hostile_lengths_never_allocate():
+    """A hostile index (well-formed CRC!) declaring a leaf far larger than
+    the frame carries must be rejected as WireError — crucially BEFORE the
+    receiver allocates the declared size (a 4 GiB np.empty per hostile
+    frame would be a memory DoS the old whole-payload reader was immune
+    to). Not a CrcError: the bytes arrived exactly as sent."""
+    import json as _json
+
+    idx = _json.dumps([{"path": "x", "dtype": "<f8",
+                        "shape": [1 << 28]}]).encode()
+    hdr = _json.dumps({"type": "update"}).encode()
+    payload = (struct.pack("<I", len(hdr)) + hdr + struct.pack("<I", 1)
+               + struct.pack("<I", 1) + b"n"
+               + struct.pack("<I", len(idx)) + idx
+               + struct.pack("<Q", 16) + b"\x00" * 16)
+    frame = (MAGIC + struct.pack("<Q", len(payload))
+             + struct.pack("<I", zlib.crc32(payload)) + payload)
+    sock = _feed(frame)
+    try:
+        with pytest.raises(WireError) as ei:
+            read_frame(sock, timeout_s=3.0)
+        assert not isinstance(ei.value, CrcError)
+    finally:
+        sock.close()
+    # a declared body_len overrunning the payload is equally rejected
+    payload2 = (struct.pack("<I", len(hdr)) + hdr + struct.pack("<I", 1)
+                + struct.pack("<I", 1) + b"n"
+                + struct.pack("<I", len(idx)) + idx
+                + struct.pack("<Q", 1 << 40))
+    frame2 = (MAGIC + struct.pack("<Q", len(payload2))
+              + struct.pack("<I", zlib.crc32(payload2)) + payload2)
+    sock = _feed(frame2)
+    try:
+        with pytest.raises(WireError) as ei:
+            read_frame(sock, timeout_s=3.0)
+        assert not isinstance(ei.value, CrcError)
+    finally:
+        sock.close()
+
+
+def test_streamed_corrupt_frac_matches_flip_positions():
+    """The writer's chaos-corruption hook flips the same payload offsets
+    the pre-streaming _flip_payload_bytes did: min(int(f*n), n-1), past
+    the prefix — pinned so the seeded chaos lane's draws stay replayable
+    across the refactor."""
+    ref = bytearray(pack_frame(_STREAM_HDR, _STREAM_TREES))
+    n = len(ref) - PREFIX_LEN
+    fracs = [0.0, 0.5, 0.999999]
+    for f in fracs:
+        ref[PREFIX_LEN + min(int(f * n), n - 1)] ^= 0xFF
+    got = _capture_stream(
+        lambda s: write_frame(s, _STREAM_HDR, _STREAM_TREES,
+                              corrupt_frac=fracs))
+    assert got == bytes(ref)
 
 
 # -------------------------------------------------- detector + retry seam
@@ -414,7 +607,7 @@ def test_chaos_corruption_is_caught_by_crc_and_healed_by_retry():
             def __init__(self):
                 self.plan = FaultPlan(wire_corrupt_prob=1.0)
 
-            def actions(self, src, dst, msg_id, attempt):
+            def actions(self, src, dst, msg_id, attempt, clock=None):
                 if attempt > 0:
                     return None
                 return self.plan.wire_actions(0, src, dst, msg_id, attempt)
@@ -429,6 +622,104 @@ def test_chaos_corruption_is_caught_by_crc_and_healed_by_retry():
         assert b.crc_drops == 1  # the corrupt copy died before parsing
     finally:
         b.close()
+
+
+# ------------------------------------------------- pipelined sender (r11)
+
+
+def test_send_async_per_destination_ordering_and_flush():
+    """The pipelined seam's contract: msg_ids are allocated in enqueue
+    order, the worker drains FIFO, so one destination's frames arrive in
+    msg-id order; flush_sends blocks until the queue is drained AND the
+    protocol completed for every frame."""
+    ports = free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    a = PeerTransport(0, addrs, policy=_policy(pipeline_depth=2))
+    b = PeerTransport(1, addrs, policy=_policy())
+    b.start()
+    try:
+        for i in range(10):
+            assert a.send_async(1, {"type": "ping", "n": i}) is True
+        assert a.flush_sends(timeout_s=10.0) is True
+        got = []
+        msg = b.recv(2.0)
+        while msg is not None:
+            got.append((msg[0]["msg_id"], msg[0]["n"]))
+            msg = b.recv(0.2)
+        assert got == [(i, i) for i in range(10)]
+        assert a.stats()["pipeline"]["async_enqueued"] == 10
+        assert a.send_failures == 0
+    finally:
+        b.close()
+        a.close()
+
+
+def test_send_async_backpressure_blocks_on_full_queue():
+    """Bounded handoff: with pipeline_depth=1 and an unreachable
+    destination (every attempt burns the full retry schedule in the
+    worker), the THIRD enqueue must BLOCK until the worker frees a slot —
+    frames can never pile up beyond depth+1 per destination."""
+    ports = free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    # nothing listens on peer 1: each logical send takes ~3 fast refused
+    # connects + two ~10ms backoffs in the worker
+    a = PeerTransport(0, addrs,
+                      policy=_policy(pipeline_depth=1, retry_base_s=0.05,
+                                     retry_max_s=0.1, down_after=100))
+    try:
+        t0 = time.time()
+        assert a.send_async(1, {"type": "ping", "n": 0}) is True  # worker
+        assert a.send_async(1, {"type": "ping", "n": 1}) is True  # queued
+        fast = time.time() - t0
+        assert fast < 0.5, "enqueue up to depth must not block"
+        t0 = time.time()
+        assert a.send_async(1, {"type": "ping", "n": 2}) is True
+        blocked = time.time() - t0
+        assert blocked > 0.02, ("third enqueue should have waited for the "
+                                "worker to free a slot (back-pressure)")
+        assert a.flush_sends(timeout_s=15.0) is True
+        assert a.send_failures == 3  # all three exhausted their budgets
+        assert a.stats()["pipeline"]["backpressure_blocks"] >= 1
+    finally:
+        a.close()
+
+
+def test_send_async_under_wire_chaos_dedup_and_drop():
+    """The pipeline composes with the wire chaos lane: dup=1.0 duplicates
+    every delivery (the receiver's dedup window absorbs the copies — each
+    logical send surfaces exactly once), and a drop=1.0 sender records its
+    failures through the worker without ever blocking the enqueue path
+    past the queue bound."""
+    ports = free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    b = PeerTransport(1, addrs, policy=_policy())
+    b.start()
+    dup = PeerTransport(
+        0, addrs, policy=_policy(),
+        chaos=WireChaos(FaultPlan(wire_dup_prob=1.0), lambda: 0))
+    try:
+        for i in range(4):
+            assert dup.send_async(1, {"type": "ping", "n": i}) is True
+        assert dup.flush_sends(timeout_s=10.0) is True
+        got = []
+        msg = b.recv(2.0)
+        while msg is not None:
+            got.append(msg[0]["n"])
+            msg = b.recv(0.3)
+        assert got == [0, 1, 2, 3]  # once each, in order
+        assert b.dups_dropped >= 4
+        drop = PeerTransport(
+            0, addrs, policy=_policy(),
+            chaos=WireChaos(FaultPlan(wire_drop_prob=1.0), lambda: 0))
+        drop._next_msg_id[1] = 100  # distinct id space from `dup`
+        assert drop.send_async(1, {"type": "ping"}) is True
+        assert drop.flush_sends(timeout_s=10.0) is True
+        assert drop.send_failures == 1
+        assert drop.chaos_injected["drop"] == 3  # initial + 2 retries
+        assert b.recv(0.3) is None
+    finally:
+        b.close()
+        dup.close()
 
 
 # ----------------------------------------------------------- static guard
@@ -463,3 +754,34 @@ def test_every_dist_socket_op_has_a_deadline():
         "socket call sites without a visible deadline "
         "(add a timeout or a '# deadline: ...' pointer):\n"
         + "\n".join(offenders))
+
+
+def test_no_full_frame_payload_concat_outside_wire():
+    """Static guard for the r11 zero-copy send path: no code outside
+    ``wire.py`` may build a full frame payload as one ``bytes`` —
+    ``pack_frame`` (the in-memory reference) must not be called from
+    production code, and nothing under ``bcfl_tpu/dist`` may ``b"".join``
+    a payload. A regression here silently doubles peak serialization
+    memory per send (a model-sized copy), exactly what the streaming
+    writer (``wire.write_frame``) exists to avoid."""
+    offenders = []
+    pkg = os.path.join(REPO, "bcfl_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg)
+            if rel == os.path.join("dist", "wire.py"):
+                continue  # the reference implementation lives here
+            with open(path) as f:
+                lines = f.read().splitlines()
+            for i, line in enumerate(lines):
+                code = line.split("#", 1)[0]
+                if "pack_frame(" in code:
+                    offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+                if (rel.startswith("dist") and 'b"".join' in code):
+                    offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+    assert not offenders, (
+        "full-frame payload concatenation outside wire.py (stream via "
+        "wire.write_frame instead):\n" + "\n".join(offenders))

@@ -1,0 +1,45 @@
+"""`bcfl-tpu lint` — AST-based static analysis of the repo's concurrency,
+determinism, and telemetry contracts (ANALYSIS.md).
+
+- :mod:`bcfl_tpu.analysis.core` — the framework: :class:`Finding`,
+  :class:`Checker` + registry, the ``# lint: disable=<id> — <why>``
+  suppression convention, the committed baseline, and the
+  :func:`lint_main` CLI (``bcfl-tpu lint``).
+- :mod:`bcfl_tpu.analysis.concurrency` — **guarded-by** (registered
+  shared fields only touched under their declared lock) and
+  **lock-order** (the static acquisition graph is cycle-free).
+- :mod:`bcfl_tpu.analysis.determinism` — **determinism** (seeded-draw
+  modules: no wall clock, no module-level RNG, no unsorted dict/set
+  iteration).
+- :mod:`bcfl_tpu.analysis.telemetry_schema` — **telemetry-schema**
+  (every literal emit names an EVENT_TYPES entry with its required
+  fields).
+- :mod:`bcfl_tpu.analysis.wire_static` — **socket-deadline** and
+  **no-frame-concat** (the AST successors of the two grep guards that
+  used to live in tests/test_wire_chaos.py).
+
+stdlib-only: no jax, no third-party imports.
+"""
+
+from bcfl_tpu.analysis import (  # noqa: F401 — populate the registry
+    concurrency,
+    determinism,
+    telemetry_schema,
+    wire_static,
+)
+from bcfl_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    DEFAULT_BASELINE,
+    JSON_VERSION,
+    PACKAGE_DIR,
+    Checker,
+    Finding,
+    Source,
+    baseline_json,
+    checker_ids,
+    lint_main,
+    load_baseline,
+    run_lint,
+)
+from bcfl_tpu.analysis.determinism import SEEDED_SCOPE  # noqa: F401
+from bcfl_tpu.analysis.wire_static import iter_socket_sites  # noqa: F401

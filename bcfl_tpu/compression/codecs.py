@@ -289,7 +289,10 @@ def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
             by_n.setdefault(y.shape[1], []).append(i)
         vals = [None] * len(ys)
         idxs = [None] * len(ys)
-        for n, group in by_n.items():
+        # sorted: group processing order must be a function of the leaf
+        # WIDTHS, not of flatten insertion order — results land by leaf
+        # index either way, but the trace/draw order stays host-invariant
+        for n, group in sorted(by_n.items()):
             parts = _topk_parts_batched([ys[i] for i in group],
                                         _leaf_k(comp, n))
             for i, (v, ix) in zip(group, parts):
@@ -303,7 +306,7 @@ def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
         by_ck: dict = {}
         for i, v in enumerate(vals):
             by_ck.setdefault(min(comp.chunk, v.shape[1]), []).append(i)
-        for ck, group in by_ck.items():
+        for ck, group in sorted(by_ck.items()):  # same order contract
             parts = _int8_parts_batched(
                 [vals[i] for i in group], [keys[i] for i in group],
                 ck, comp.stochastic)

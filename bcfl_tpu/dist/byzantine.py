@@ -56,9 +56,15 @@ def _map_floats(tree, fn):
     """Apply ``fn`` to every float ndarray leaf of a (nested dict) host
     tree — the same "perturb the float parts" semantics as the corruption
     lanes: quantized int8 codes / int payloads ride along untouched, the
-    scales/values that reconstruct the update are what get poisoned."""
+    scales/values that reconstruct the update are what get poisoned.
+
+    Keys are visited in SORTED order: ``fn`` consumes seeded RNG draws
+    per leaf (garbage/equivocate), so the visit order IS part of the
+    determinism contract — insertion order would tie the mutated bytes to
+    however the host happened to build the tree, not to the (seed, round,
+    peer, dst) coordinates."""
     if isinstance(tree, dict):
-        return {k: _map_floats(v, fn) for k, v in tree.items()}
+        return {k: _map_floats(tree[k], fn) for k in sorted(tree)}
     arr = np.asarray(tree)
     if np.issubdtype(arr.dtype, np.floating):
         return fn(arr)

@@ -187,8 +187,10 @@ class PeerRuntime:
         self.reconcile: Optional[Dict] = None
         self._below_quorum = False
         self._below_quorum_events = 0  # episodes, not loop polls
-        self._buffer: List[tuple] = []  # (header, trees, recv_time)
-        self._buffer_shed = 0  # oldest entries shed by the intake cap
+        self._buffer: List[tuple] = []  # guarded-by: _buffer_lock — (header, trees, recv_time)
+        # shed count: writes under the buffer lock; the report's read is
+        # a GIL-atomic snapshot (hence the (writes) qualifier)
+        self._buffer_shed = 0  # guarded-by: _buffer_lock (writes)
         # double-buffered intake (cfg.dist.pipeline, RUNTIME.md §4): an
         # intake thread drains the transport inbox continuously — UPDATE
         # arrivals land in self._buffer under this lock (the active
@@ -210,7 +212,7 @@ class PeerRuntime:
         # so under flood that timestamp keeps advancing and a timeout
         # measured from it can never fire (a dead peer holding
         # distinct < want would park merges forever)
-        self._buffer_since = 0.0
+        self._buffer_since = 0.0  # guarded-by: _buffer_lock
         self._partitioned = False
         self._fork_comps = None
         self._pending_reconcile = False
@@ -300,11 +302,13 @@ class PeerRuntime:
         # Reentrant lock: the deadline Timer thread, the main loop's
         # periodic flush, and the SIGTERM handler (which interrupts the
         # main thread mid-frame) all write the same report file.
-        self._report_round = -1
-        self._report_version = -1
         self._report_lock = threading.RLock()
-        self._report_terminal = False
-        self._chain_ok_cache: Optional[bool] = None
+        # cadence markers: written by whichever thread rewrites the
+        # report; the main loop's due-check reads are snapshots
+        self._report_round = -1    # guarded-by: _report_lock (writes)
+        self._report_version = -1  # guarded-by: _report_lock (writes)
+        self._report_terminal = False  # guarded-by: _report_lock
+        self._chain_ok_cache: Optional[bool] = None  # guarded-by: _report_lock
         # SIGTERM leaves a current report + flushed event stream behind
         # (SIGKILL cannot be caught — there the periodic rewrites are the
         # whole story). Registered in the peer's main thread.
@@ -1443,14 +1447,14 @@ class PeerRuntime:
                 self._report_terminal = True
             self._write_report_locked(status)
 
-    def _chain_ok(self, status: str) -> Optional[bool]:
+    def _chain_ok(self, status: str) -> Optional[bool]:  # guarded-by: _report_lock
         if self.chain is None:
             return None
         if status != "running" or self._chain_ok_cache is None:
             self._chain_ok_cache = self.chain.verify_chain() == -1
         return self._chain_ok_cache
 
-    def _write_report_locked(self, status: str):
+    def _write_report_locked(self, status: str):  # guarded-by: _report_lock
         self._report_round = self.local_round
         self._report_version = self.version
         staleness = [a["staleness"] for m in self.merges for a in m.arrivals]

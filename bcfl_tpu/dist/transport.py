@@ -118,17 +118,20 @@ class FailureDetector:
         self.suspect_after = int(suspect_after)
         self.down_after = int(down_after)
         self.probe_interval_s = float(probe_interval_s)
-        self._state = {p: REACHABLE for p in range(int(peers))}
-        self._fails = {p: 0 for p in range(int(peers))}
-        self._last_probe = {p: 0.0 for p in range(int(peers))}
+        self._lock = threading.Lock()
+        self._state = {p: REACHABLE for p in range(int(peers))}  # guarded-by: _lock
+        self._fails = {p: 0 for p in range(int(peers))}  # guarded-by: _lock
+        self._last_probe = {p: 0.0 for p in range(int(peers))}  # guarded-by: _lock
         # bounded: a long-lived peer on a lossy link flaps at message
         # rate, and the full log is serialized into every report — keep
-        # the recent window (enough for the chaos gates) plus a total
-        self.transitions = collections.deque(maxlen=256)
-        self.transitions_total = 0
-        self._lock = threading.Lock()
+        # the recent window (enough for the chaos gates) plus a total.
+        # Writes under the lock; external readers (the transport's stats
+        # rollup, the runtime's evidence drain) take snapshot reads of
+        # the deque/int, which CPython keeps tear-free.
+        self.transitions = collections.deque(maxlen=256)  # guarded-by: _lock (writes)
+        self.transitions_total = 0  # guarded-by: _lock (writes)
 
-    def _set(self, peer: int, state: str) -> None:
+    def _set(self, peer: int, state: str) -> None:  # guarded-by: _lock
         old = self._state[peer]
         if old == state:
             return
@@ -269,17 +272,23 @@ class PeerTransport:
         self.detector = FailureDetector(
             len(addrs), self.policy.suspect_after, self.policy.down_after,
             self.policy.probe_interval_s)
+        # receive-path counters are bumped from concurrent per-connection
+        # serve threads AND (with the pipeline on) the sender workers: a
+        # plain += is a racy read-add-store there. Writes go through
+        # _bump / locked sections; stats() reads are GIL-atomic snapshots
+        # (the (writes) qualifier states exactly that contract).
+        self._stats_lock = threading.Lock()
         # --- observability counters (stats()) ---
-        self.retries = 0            # re-attempts after a failed attempt
-        self.send_failures = 0      # logical sends that exhausted the budget
-        self.dups_dropped = 0       # dedup-window drops (at-least-once tax)
-        self.crc_drops = 0          # inbound frames failing their CRC
-        self.wire_drops = 0         # inbound frames malformed/stalled
-        self.inbox_overflow = 0     # frames shed by the bounded inbox
-        self.reorders_held = 0      # frames held for chaos reordering
-        self.circuit_skips = 0      # sends skipped on an open circuit
-        self.dropped_by_gate = 0    # receiver-side partition drops
-        self.chaos_injected = {"drop": 0, "dup": 0, "reorder": 0,
+        self.retries = 0            # guarded-by: _stats_lock (writes) — re-attempts
+        self.send_failures = 0      # guarded-by: _stats_lock (writes) — budget exhausted
+        self.dups_dropped = 0       # guarded-by: _stats_lock (writes) — dedup drops
+        self.crc_drops = 0          # guarded-by: _stats_lock (writes) — CRC failures
+        self.wire_drops = 0         # guarded-by: _stats_lock (writes) — malformed/stalled
+        self.inbox_overflow = 0     # guarded-by: _stats_lock (writes) — bounded-inbox sheds
+        self.reorders_held = 0      # guarded-by: _stats_lock (writes) — chaos holds
+        self.circuit_skips = 0      # guarded-by: _stats_lock (writes) — open-circuit skips
+        self.dropped_by_gate = 0    # guarded-by: _stats_lock (writes) — partition drops
+        self.chaos_injected = {"drop": 0, "dup": 0, "reorder": 0,  # guarded-by: _stats_lock (writes)
                                "delay": 0, "corrupt": 0}
         # the sender's incarnation epoch: part of the dedup identity, so a
         # restarted peer (fresh msg-id counter) opens a fresh window at
@@ -291,15 +300,10 @@ class PeerTransport:
         # between incarnations; the wall-ms default covers ad-hoc use.
         self.epoch = (int(epoch) if epoch is not None
                       else time.time_ns() // 1_000_000)
-        self._next_msg_id: Dict[int, int] = {}
-        self._dedup_seen: Dict[int, set] = {}
-        self._dedup_max: Dict[int, int] = {}
-        self._dedup_epoch: Dict[int, int] = {}
         self._dedup_lock = threading.Lock()
-        # receive-path counters are bumped from concurrent per-connection
-        # serve threads AND (with the pipeline on) the sender workers: a
-        # plain += is a racy read-add-store there
-        self._stats_lock = threading.Lock()
+        self._dedup_seen: Dict[int, set] = {}   # guarded-by: _dedup_lock
+        self._dedup_max: Dict[int, int] = {}    # guarded-by: _dedup_lock
+        self._dedup_epoch: Dict[int, int] = {}  # guarded-by: _dedup_lock
         self._server: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._closing = threading.Event()
@@ -312,12 +316,13 @@ class PeerTransport:
         # The bounded queue IS the back-pressure: a slow link blocks the
         # enqueuing round loop after pipeline_depth frames instead of
         # buffering model-sized trees without bound.
-        self._send_queues: Dict[int, "queue.Queue"] = {}
         self._send_lock = threading.Lock()  # msg-id alloc + worker spawn
-        self._inflight = 0  # async sends enqueued or executing
+        self._send_queues: Dict[int, "queue.Queue"] = {}  # guarded-by: _send_lock
+        self._next_msg_id: Dict[int, int] = {}  # guarded-by: _send_lock
         self._inflight_cv = threading.Condition()
-        self.async_enqueued = 0     # logical sends handed to a worker
-        self.backpressure_blocks = 0  # enqueues that had to wait on a full queue
+        self._inflight = 0  # guarded-by: _inflight_cv — sends enqueued or executing
+        self.async_enqueued = 0     # guarded-by: _stats_lock (writes) — handed to a worker
+        self.backpressure_blocks = 0  # guarded-by: _stats_lock (writes) — waited on full queue
 
     def _bump(self, name: str) -> None:
         with self._stats_lock:
@@ -896,6 +901,9 @@ class PeerTransport:
             "pipeline": {
                 "async_enqueued": self.async_enqueued,
                 "backpressure_blocks": self.backpressure_blocks,
+                # lint: disable=guarded-by — len() snapshot for the
+                # report rollup: a torn size is impossible (GIL) and a
+                # stale one is acceptable observability lag
                 "workers": len(self._send_queues),
             },
             "chaos_injected": dict(self.chaos_injected),

@@ -6,6 +6,9 @@ config knob is an override flag.
 Subcommands: ``bcfl-tpu trace RUN_DIR`` collates a run's per-process event
 streams into one causally-ordered timeline and runs the invariant checks
 (bcfl_tpu.telemetry, OBSERVABILITY.md) — exit 1 on any violation.
+``bcfl-tpu lint [PATHS]`` runs the AST static-analysis checkers over the
+package (bcfl_tpu.analysis, ANALYSIS.md) — exit 1 on any unsuppressed
+finding; ``--list-checkers`` prints the catalogue.
 """
 
 from __future__ import annotations
@@ -28,6 +31,14 @@ def main(argv=None):
         from bcfl_tpu.telemetry import trace_main
 
         raise SystemExit(trace_main(argv[1:]))
+    if argv and argv[0] == "lint":
+        # the static-analysis subcommand (ANALYSIS.md): the checkers are
+        # stdlib-ast only (the package import chain still pays the usual
+        # bcfl_tpu config imports, like trace); exits nonzero on any
+        # unsuppressed finding
+        from bcfl_tpu.analysis import lint_main
+
+        raise SystemExit(lint_main(argv[1:]))
     ap = argparse.ArgumentParser(prog="bcfl_tpu")
     ap.add_argument("--preset", default="smoke",
                     help=f"one of: {', '.join(list_presets())}")

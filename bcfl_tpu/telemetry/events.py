@@ -143,16 +143,16 @@ class EventWriter:
         self._f = open(path, "ab")
         if needs_nl:
             self._f.write(b"\n")
-        self._buf: list = []
         # reentrant: a signal handler (the peer's SIGTERM path) may emit
         # while the interrupted main-thread frame already holds the lock
         self._lock = threading.RLock()
-        self._seq = 0
-        self._last_flush = time.monotonic()
-        self._closed = False
-        self.emitted = 0
-        self.dropped = 0
-        self._warned: set = set()
+        self._buf: list = []       # guarded-by: _lock — pending lines
+        self._seq = 0              # guarded-by: _lock — per-writer order
+        self._last_flush = time.monotonic()  # guarded-by: _lock
+        self._closed = False       # guarded-by: _lock
+        self.emitted = 0           # guarded-by: _lock (writes)
+        self.dropped = 0           # guarded-by: _lock (writes)
+        self._warned: set = set()  # guarded-by: _lock — warned-once types
 
     # ------------------------------------------------------------------ emit
 
@@ -202,9 +202,18 @@ class EventWriter:
             self._drop(ev, repr(e))
 
     def _drop(self, ev: str, why: str) -> None:
-        self.dropped += 1
-        if ev not in self._warned:
-            self._warned.add(ev)
+        # under the lock: _drop is reached from concurrent emitters
+        # (transport serve threads + the main loop share one writer), and
+        # a bare += here is the read-add-store race the guarded-by
+        # contract exists to reject — a lost drop count would make the
+        # "zero dropped events" gates pass vacuously. RLock, so the
+        # flush-failure path (already holding it) re-enters fine.
+        with self._lock:
+            self.dropped += 1
+            warn = ev not in self._warned
+            if warn:
+                self._warned.add(ev)
+        if warn:
             logger.warning("telemetry: dropped %r event (%s)", ev, why)
 
     def sampled(self, key) -> bool:
@@ -224,7 +233,7 @@ class EventWriter:
 
     # ----------------------------------------------------------------- flush
 
-    def _flush_locked(self) -> None:
+    def _flush_locked(self) -> None:  # guarded-by: _lock
         if self._buf:
             # detach the buffer BEFORE writing: a reentrant emit (signal
             # handler interrupting this very write) appends to the fresh

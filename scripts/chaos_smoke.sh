@@ -15,6 +15,42 @@
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 set -uo pipefail
 cd "$(dirname "$0")/.."
+
+# Lint leg (ANALYSIS.md): the AST contract checkers standalone — the
+# repo-wide run must be clean (exit 0: zero unsuppressed findings across
+# guarded-by / lock-order / determinism / telemetry-schema /
+# socket-deadline / no-frame-concat), and a seeded-violation fixture must
+# fail (exit 1) so a silently-inert linter can never pass this leg.
+echo "lint leg: bcfl-tpu lint over bcfl_tpu/ (AST contract checkers)"
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m bcfl_tpu.entrypoints lint bcfl_tpu
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "lint leg FAILED (rc=$rc): unsuppressed contract findings" >&2
+  exit "$rc"
+fi
+LINT_FIXTURE=$(mktemp /tmp/bcfl_lint_fixture_XXXXXX.py)
+cat > "$LINT_FIXTURE" <<'EOF'
+# seeded violation: pack_frame outside wire.py + an unsorted seeded draw
+from bcfl_tpu.dist.wire import pack_frame
+
+
+def ship(sock, header, trees, d):
+    for k, v in d.items():
+        pass
+    sock.sendall(pack_frame(header, trees))
+EOF
+if timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m bcfl_tpu.entrypoints lint --no-baseline "$LINT_FIXTURE" \
+    > /dev/null 2>&1; then
+  echo "lint leg FAILED: seeded-violation fixture passed (the checkers" \
+       "are inert)" >&2
+  rm -f "$LINT_FIXTURE"
+  exit 1
+fi
+rm -f "$LINT_FIXTURE"
+echo "lint leg OK: repo-wide clean, seeded violation detected"
+
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_faults.py tests/test_reputation.py -q \
     -m '(faults or reputation) and not slow' \

@@ -1,0 +1,708 @@
+"""`bcfl-tpu lint` — the AST static-analysis subsystem (marker
+``analysis``, tier-1; bcfl_tpu.analysis, ANALYSIS.md).
+
+Layers covered:
+
+- one FIRING fixture + one CLEAN twin per checker (the checker detects
+  exactly its contract violation, and does not cry wolf on the compliant
+  spelling),
+- the suppression convention round-trip (justified suppressions suppress;
+  a suppression without a justification suppresses nothing and is itself
+  a finding),
+- the baseline round-trip (grandfathered findings pass; ``--no-baseline``
+  un-grandfathers them) and ``--json`` schema stability,
+- the REPO-WIDE standing guard: ``bcfl-tpu lint bcfl_tpu`` has zero
+  unsuppressed findings and the committed baseline is empty for
+  ``dist/``, ``faults/``, and ``telemetry/`` — every contract is
+  enforced live, nothing is grandfathered there,
+- grep parity: the AST socket-deadline checker examines every call site
+  the old substring guard in tests/test_wire_chaos.py matched (it
+  replaced that guard; coverage must be a superset).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from bcfl_tpu.analysis import (
+    DEFAULT_BASELINE,
+    SEEDED_SCOPE,
+    baseline_json,
+    checker_ids,
+    iter_socket_sites,
+    lint_main,
+    load_baseline,
+    run_lint,
+)
+from bcfl_tpu.analysis.core import Source
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BCFL = os.path.join(REPO, "bcfl_tpu")
+
+ALL_CHECKERS = ("determinism", "guarded-by", "lock-order",
+                "no-frame-concat", "socket-deadline", "telemetry-schema")
+
+
+def _lint(tmp_path, code, checker, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return [f for f in run_lint([str(p)], checker_ids_filter=[checker],
+                                use_baseline=False)]
+
+
+def _failing(findings):
+    return [f for f in findings if f.failing]
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_lists_all_six_checkers():
+    assert tuple(checker_ids()) == ALL_CHECKERS
+
+
+def test_list_checkers_cli(capsys):
+    assert lint_main(["--list-checkers"]) == 0
+    out = capsys.readouterr().out
+    for cid in ALL_CHECKERS:
+        assert cid in out
+
+
+# ------------------------------------------------- guarded-by (fixtures)
+
+
+_GUARDED_FIRING = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  # guarded-by: _lock
+
+        def bump(self):
+            self.n += 1
+"""
+
+_GUARDED_CLEAN = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0  # guarded-by: _lock
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+"""
+
+
+def test_guarded_by_fires_on_unlocked_access(tmp_path):
+    fs = _failing(_lint(tmp_path, _GUARDED_FIRING, "guarded-by"))
+    assert len(fs) == 1
+    assert "C.n is guarded by self._lock" in fs[0].message
+    assert "written" in fs[0].message
+
+
+def test_guarded_by_clean_twin(tmp_path):
+    assert not _failing(_lint(tmp_path, _GUARDED_CLEAN, "guarded-by"))
+
+
+def test_guarded_by_method_annotation_means_caller_holds(tmp_path):
+    code = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # guarded-by: _lock
+
+            def _bump_locked(self):  # guarded-by: _lock
+                self.n += 1
+    """
+    assert not _failing(_lint(tmp_path, code, "guarded-by"))
+
+
+def test_guarded_by_writes_qualifier_allows_snapshot_reads(tmp_path):
+    code = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # guarded-by: _lock (writes)
+
+            def snapshot(self):
+                return self.n
+
+            def bump_racy(self):
+                self.n += 1
+    """
+    fs = _failing(_lint(tmp_path, code, "guarded-by"))
+    assert len(fs) == 1  # only the write fires; the read is the contract
+    assert "bump_racy" in fs[0].message
+
+
+def test_guarded_by_subscript_mutation_is_a_write(tmp_path):
+    code = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.d = {}  # guarded-by: _lock (writes)
+
+            def put(self, k):
+                self.d[k] = 1
+    """
+    fs = _failing(_lint(tmp_path, code, "guarded-by"))
+    assert len(fs) == 1 and "written" in fs[0].message
+
+
+def test_guarded_by_unknown_lock_fails_loudly(tmp_path):
+    code = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self.n = 0  # guarded-by: _lok
+    """
+    fs = _failing(_lint(tmp_path, code, "guarded-by"))
+    assert len(fs) == 1 and "no lock attribute" in fs[0].message
+
+
+# ------------------------------------------------- lock-order (fixtures)
+
+
+_ORDER_FIRING = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+_ORDER_CLEAN = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ab2(self):
+            with self._a:
+                with self._b:
+                    pass
+"""
+
+
+def test_lock_order_fires_on_cycle(tmp_path):
+    fs = _failing(_lint(tmp_path, _ORDER_FIRING, "lock-order"))
+    assert len(fs) == 1
+    assert "lock-order cycle" in fs[0].message
+    assert "C._a" in fs[0].message and "C._b" in fs[0].message
+
+
+def test_lock_order_clean_twin(tmp_path):
+    assert not _failing(_lint(tmp_path, _ORDER_CLEAN, "lock-order"))
+
+
+def test_lock_order_sees_through_same_class_calls(tmp_path):
+    code = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def takes_b(self):
+                with self._b:
+                    pass
+
+            def ab(self):
+                with self._a:
+                    self.takes_b()
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    fs = _failing(_lint(tmp_path, code, "lock-order"))
+    assert len(fs) == 1 and "lock-order cycle" in fs[0].message
+
+
+def test_lock_order_nonreentrant_self_nesting(tmp_path):
+    code = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+    """
+    fs = _failing(_lint(tmp_path, code, "lock-order"))
+    assert len(fs) == 1 and "non-reentrant" in fs[0].message
+    # the RLock twin is legal re-entry
+    rlock = code.replace("threading.Lock()", "threading.RLock()")
+    assert not _failing(_lint(tmp_path, rlock, "lock-order",
+                              name="rlock_twin.py"))
+
+
+# ------------------------------------------------ determinism (fixtures)
+
+
+_DET_FIRING = """
+    import time
+    import random
+    import numpy as np
+
+    def draw(d):
+        t = time.time()
+        r = random.random()
+        g = np.random.default_rng()
+        x = np.random.random()
+        for k, v in d.items():
+            pass
+        return t, r, g, x
+"""
+
+_DET_CLEAN = """
+    import time
+    import numpy as np
+
+    def draw(d, seed, rnd):
+        rng = np.random.default_rng((seed, 3, rnd))
+        for k, v in sorted(d.items()):
+            pass
+        return rng.random()
+"""
+
+
+def test_determinism_fires_on_each_bug_class(tmp_path):
+    fs = _failing(_lint(tmp_path, _DET_FIRING, "determinism"))
+    msgs = "\n".join(f.message for f in fs)
+    assert len(fs) == 5
+    assert "wall-clock read time.time()" in msgs
+    assert "stdlib random.random()" in msgs
+    assert "default_rng() without a seed" in msgs
+    assert "np.random.random() uses the module-level global RNG" in msgs
+    assert "iteration over .items() without sorted()" in msgs
+
+
+def test_determinism_clean_twin(tmp_path):
+    assert not _failing(_lint(tmp_path, _DET_CLEAN, "determinism"))
+
+
+def test_determinism_flags_set_iteration(tmp_path):
+    code = """
+        def f(xs):
+            out = []
+            for x in set(xs):
+                out.append(x)
+            return [y for y in {1, 2, 3}]
+    """
+    fs = _failing(_lint(tmp_path, code, "determinism"))
+    assert len(fs) == 2
+    assert all("a set" in f.message for f in fs)
+
+
+def test_determinism_scope_covers_the_seeded_modules():
+    """The satellite confirmation: the modules whose iteration order
+    reaches seeded draws / lineage records are IN scope — including
+    robust.py's vote ordering, reputation/dist.py's evidence replay, and
+    runtime._apply_robust_merge's votes_by_peer construction — and the
+    standing repo-wide guard below holds them at zero findings (i.e.
+    every dict walk there is sorted)."""
+    for rel in ("faults/plan.py", "dist/byzantine.py",
+                "compression/codecs.py", "dist/robust.py",
+                "reputation/dist.py"):
+        assert SEEDED_SCOPE[rel] is None  # whole module
+    assert "WireChaos" in SEEDED_SCOPE["dist/transport.py"]
+    assert "_apply_robust_merge" in SEEDED_SCOPE["dist/runtime.py"]
+    for rel, names in SEEDED_SCOPE.items():
+        assert not _failing(run_lint(
+            [os.path.join(BCFL, rel.replace("/", os.sep))],
+            checker_ids_filter=["determinism"], use_baseline=False)), rel
+
+
+# ------------------------------------------- telemetry-schema (fixtures)
+
+
+_TELEM_FIRING = """
+    from bcfl_tpu import telemetry
+
+    def report(to):
+        telemetry.emit("sendd", to=to, type="ping", ok=True)
+        telemetry.emit("merge", version=1)
+"""
+
+_TELEM_CLEAN = """
+    from bcfl_tpu import telemetry
+    from bcfl_tpu.telemetry import events as _telemetry
+
+    def report(to, extra):
+        telemetry.emit("send", to=to, type="ping", ok=True)
+        _telemetry.emit("detector", **{"target": to, "from": "reachable",
+                                       "to": "suspect"})
+        telemetry.emit("recv", disposition="accepted", **extra)
+        telemetry.emit_sampled("chaos", (1, 2), lane="wire", action="drop")
+"""
+
+
+def test_telemetry_schema_fires_on_typo_and_missing_fields(tmp_path):
+    fs = _failing(_lint(tmp_path, _TELEM_FIRING, "telemetry-schema"))
+    assert len(fs) == 2
+    assert "unknown telemetry event type 'sendd'" in fs[0].message
+    assert "missing required field(s)" in fs[1].message
+    assert "DROPPED" in fs[0].message  # the silent failure mode, spelled out
+
+
+def test_telemetry_schema_clean_twin(tmp_path):
+    """Dict-literal ** counts as statically visible; an opaque **extra
+    skips the field check (but never the type check)."""
+    assert not _failing(_lint(tmp_path, _TELEM_CLEAN, "telemetry-schema"))
+
+
+def test_telemetry_schema_skips_writer_methods_and_dynamic_names(tmp_path):
+    code = """
+        def f(self, w, ev):
+            self.emit("not_a_type_but_not_our_seam")
+            w.emit("also_not_checked")
+            from bcfl_tpu import telemetry
+            telemetry.emit(ev, x=1)  # dynamic: runtime counter's job
+    """
+    assert not _failing(_lint(tmp_path, code, "telemetry-schema"))
+
+
+# -------------------------------------------- socket-deadline (fixtures)
+
+
+_SOCK_FIRING = """
+    def pump(sock, view):
+        data = sock.recv(4096)
+        sock.recv_into(view)
+        return data
+"""
+
+_SOCK_CLEAN = """
+    import socket
+
+    def pump(sock, view, budget):
+        conn = socket.create_connection(("h", 1), timeout=5.0)
+        sock.settimeout(budget)
+        data = sock.recv(4096)
+        sock.recv_into(view)
+        return conn, data
+
+    def accept_once(srv):
+        # deadline: settimeout(0.25) set by the caller's listener setup
+        conn, _ = srv.accept()
+        return conn
+"""
+
+
+def test_socket_deadline_fires_on_bare_ops(tmp_path):
+    fs = _failing(_lint(tmp_path, _SOCK_FIRING, "socket-deadline"))
+    assert len(fs) == 2  # recv AND recv_into — which the grep never saw
+    assert all("without a visible deadline" in f.message for f in fs)
+
+
+def test_socket_deadline_clean_twin(tmp_path):
+    assert not _failing(_lint(tmp_path, _SOCK_CLEAN, "socket-deadline"))
+
+
+def test_socket_deadline_grep_parity():
+    """The AST checker replaced the ±3-line substring guard: every call
+    site the grep patterns matched under bcfl_tpu/dist must be examined
+    by the AST checker (a strict superset — recv_into was invisible to
+    the substrings)."""
+    dist = os.path.join(BCFL, "dist")
+    patterns = (".accept(", ".recv(", "create_connection(", ".connect(")
+    grep_sites = []
+    for fname in sorted(os.listdir(dist)):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(dist, fname)) as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            code = line.split("#", 1)[0]
+            if any(p in code for p in patterns):
+                grep_sites.append((fname, i + 1))
+    assert grep_sites, "grep found nothing — the parity check is vacuous"
+    ast_sites = set()
+    for fname in sorted(os.listdir(dist)):
+        if not fname.endswith(".py"):
+            continue
+        src = Source(os.path.join(dist, fname))
+        for call, _op, _fn in iter_socket_sites(src.tree):
+            for ln in range(call.lineno,
+                            (call.end_lineno or call.lineno) + 1):
+                ast_sites.add((fname, ln))
+    missing = [s for s in grep_sites if s not in ast_sites]
+    assert not missing, (
+        f"grep-matched socket sites the AST checker never examined: "
+        f"{missing}")
+
+
+# -------------------------------------------- no-frame-concat (fixtures)
+
+
+_CONCAT_FIRING = """
+    from bcfl_tpu.dist.wire import pack_frame
+
+    def ship(sock, header, trees, parts):
+        frame = pack_frame(header, trees)
+        body = b"".join(parts)
+        sock.sendall(frame + body)
+"""
+
+_CONCAT_CLEAN = """
+    from bcfl_tpu.dist.wire import write_frame
+
+    def ship(sock, header, trees):
+        return write_frame(sock, header, trees)
+"""
+
+
+def test_no_frame_concat_fires(tmp_path):
+    fs = _failing(_lint(tmp_path, _CONCAT_FIRING, "no-frame-concat"))
+    assert len(fs) == 2
+    assert "pack_frame() call outside dist/wire.py" in fs[0].message
+    assert 'b"".join' in fs[1].message
+
+
+def test_no_frame_concat_clean_twin(tmp_path):
+    assert not _failing(_lint(tmp_path, _CONCAT_CLEAN, "no-frame-concat"))
+
+
+def test_no_frame_concat_wire_is_exempt():
+    """The reference implementation itself must not be flagged."""
+    fs = _failing(run_lint([os.path.join(BCFL, "dist", "wire.py")],
+                           checker_ids_filter=["no-frame-concat"],
+                           use_baseline=False))
+    assert not fs
+
+
+# ------------------------------------------- suppression + baseline
+
+
+def test_suppression_roundtrip(tmp_path):
+    code = _GUARDED_FIRING.replace(
+        "self.n += 1",
+        "self.n += 1  # lint: disable=guarded-by — fixture: single-"
+        "threaded by construction")
+    fs = _lint(tmp_path, code, "guarded-by")
+    assert len(fs) == 1
+    assert fs[0].suppressed and not fs[0].failing
+    assert "single-threaded" in fs[0].justification
+
+
+def test_suppression_standalone_comment_covers_next_line(tmp_path):
+    code = _GUARDED_FIRING.replace(
+        "            self.n += 1",
+        "            # lint: disable=guarded-by — fixture: single-"
+        "threaded\n"
+        "            self.n += 1")
+    assert code != _GUARDED_FIRING
+    fs = _lint(tmp_path, code, "guarded-by")
+    assert len(fs) == 1 and fs[0].suppressed
+
+
+def test_unjustified_suppression_suppresses_nothing(tmp_path):
+    code = _GUARDED_FIRING.replace(
+        "self.n += 1", "self.n += 1  # lint: disable=guarded-by")
+    fs = run_lint([_write(tmp_path, code)], use_baseline=False)
+    failing = _failing(fs)
+    # the original finding still fires AND the bad suppression is one too
+    assert {f.checker for f in failing} == {"guarded-by", "suppression"}
+    assert any("without a justification" in f.message for f in failing)
+
+
+def _write(tmp_path, code, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return str(p)
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = _write(tmp_path, _GUARDED_FIRING)
+    fs = _failing(run_lint([path], use_baseline=False))
+    assert len(fs) == 1
+    # grandfather it, line-number-free, then lint against that baseline
+    bl = tmp_path / "baseline.json"
+    bl.write_text(baseline_json(fs))
+    fs2 = run_lint([path], use_baseline=True, baseline_path=str(bl))
+    assert len(fs2) == 1 and fs2[0].baselined and not fs2[0].failing
+    # --no-baseline (use_baseline=False) un-grandfathers it again
+    assert len(_failing(run_lint([path], use_baseline=False))) == 1
+
+
+def test_cli_exit_codes_and_json_schema(tmp_path, capsys):
+    bad = _write(tmp_path, _GUARDED_FIRING, "bad.py")
+    good = _write(tmp_path, _GUARDED_CLEAN, "good.py")
+    assert lint_main([good, "--no-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main([bad, "--no-baseline", "--json"]) == 1
+    data = json.loads(capsys.readouterr().out)
+    # schema stability: these key sets are the --json contract
+    assert set(data) == {"version", "checkers", "findings", "counts"}
+    assert data["version"] == 1
+    assert data["checkers"] == list(ALL_CHECKERS)
+    assert set(data["counts"]) == {"total", "suppressed", "baselined",
+                                   "failing"}
+    assert data["counts"]["failing"] == 1
+    (row,) = data["findings"]
+    assert set(row) == {"checker", "file", "line", "message",
+                        "suppressed", "baselined"}
+    assert row["checker"] == "guarded-by" and row["file"] == "bad.py"
+
+
+def test_cli_rejects_unknown_checker(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    with pytest.raises(SystemExit) as exc:
+        lint_main(["--checker", "nope", str(tmp_path)])
+    assert exc.value.code == 2  # argparse usage error, not a crash
+
+
+def test_empty_path_set_is_an_error_not_a_pass(tmp_path):
+    """A typo'd path (or the wrong cwd) must never make the standing
+    guard pass vacuously over zero files."""
+    with pytest.raises(ValueError, match="nothing to lint"):
+        run_lint([str(tmp_path / "no_such_dir")], use_baseline=False)
+    with pytest.raises(SystemExit) as exc:
+        lint_main([str(tmp_path / "no_such_dir")])
+    assert exc.value.code == 2
+
+
+def test_corrupt_baseline_fails_loudly(tmp_path):
+    """Merge-conflict garbage in baseline.json is one clear error, not a
+    raw traceback and not a silently-empty baseline."""
+    path = _write(tmp_path, _GUARDED_FIRING)
+    bad = tmp_path / "baseline.json"
+    bad.write_text("<<<<<<< not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        run_lint([path], use_baseline=True, baseline_path=str(bad))
+    bad.write_text('{"findings": [{"file": "x"}]}')  # schema drift
+    with pytest.raises(ValueError, match="unreadable"):
+        run_lint([path], use_baseline=True, baseline_path=str(bad))
+
+
+def test_write_baseline_is_a_superset_of_the_existing_one(tmp_path,
+                                                          capsys):
+    """Regenerating the baseline must keep already-grandfathered entries:
+    --write-baseline emits every unsuppressed finding, including ones the
+    current baseline masks (redirecting over baseline.json is safe)."""
+    path = _write(tmp_path, _GUARDED_FIRING)
+    fs = _failing(run_lint([path], use_baseline=False))
+    bl = tmp_path / "baseline.json"
+    bl.write_text(baseline_json(fs))  # grandfather the finding
+    assert lint_main([path, "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert len(data["findings"]) == 1  # still present, not dropped
+
+
+# ------------------------------------------------- the standing guard
+
+
+def test_repo_wide_lint_is_clean():
+    """THE standing guard (the acceptance gate): every contract checker
+    over the whole package, zero unsuppressed findings — new code that
+    breaks a concurrency/determinism/telemetry/wire contract fails here,
+    at commit time, not as a flaky loopback run."""
+    findings = run_lint([BCFL])
+    failing = _failing(findings)
+    assert not failing, (
+        "bcfl-tpu lint found unsuppressed contract violations:\n"
+        + "\n".join(f.render() for f in failing))
+
+
+def test_committed_baseline_is_empty_for_core_dirs():
+    """dist/, faults/, and telemetry/ carry no grandfathered findings:
+    their contracts are enforced live, with per-site justified
+    suppressions the only escape hatch."""
+    rows = load_baseline(DEFAULT_BASELINE)
+    core = [r for r in rows
+            if r[1].startswith(("bcfl_tpu/dist/", "bcfl_tpu/faults/",
+                                "bcfl_tpu/telemetry/"))]
+    assert not core, f"grandfathered findings in core dirs: {core}"
+
+
+def test_repo_wide_guarded_by_registry_nonempty():
+    """The guarded-by checker is only as strong as its registry: the
+    annotations added to transport/runtime/events must actually register
+    (an annotation-format drift would silently disable the checker)."""
+    from bcfl_tpu.analysis.concurrency import _scan_class
+    import ast as _ast
+
+    src = Source(os.path.join(BCFL, "dist", "transport.py"))
+    classes = {n.name: n for n in _ast.walk(src.tree)
+               if isinstance(n, _ast.ClassDef)}
+    info = _scan_class(src, classes["PeerTransport"])
+    assert "_stats_lock" in info.locks
+    for field in ("retries", "crc_drops", "_send_queues", "_next_msg_id",
+                  "_dedup_seen", "_inflight", "chaos_injected"):
+        assert field in info.guarded, field
+    det = _scan_class(src, classes["FailureDetector"])
+    assert "_state" in det.guarded and "_lock" in det.annotations["_set"]
+
+    ev = Source(os.path.join(BCFL, "telemetry", "events.py"))
+    ev_classes = {n.name: n for n in _ast.walk(ev.tree)
+                  if isinstance(n, _ast.ClassDef)}
+    wr = _scan_class(ev, ev_classes["EventWriter"])
+    for field in ("_buf", "_seq", "_closed", "dropped"):
+        assert field in wr.guarded, field
+
+    rt = Source(os.path.join(BCFL, "dist", "runtime.py"))
+    rt_classes = {n.name: n for n in _ast.walk(rt.tree)
+                  if isinstance(n, _ast.ClassDef)}
+    pr = _scan_class(rt, rt_classes["PeerRuntime"])
+    for field in ("_buffer", "_buffer_since", "_report_terminal"):
+        assert field in pr.guarded, field
+
+
+def test_lock_order_repo_graph_reaches_telemetry():
+    """The known cross-module seam must be modeled: detector transitions
+    and report writes emit telemetry while holding their lock, so the
+    repo graph must contain edges into EventWriter._lock (if this ever
+    goes empty, the lock-order checker has stopped seeing the emit
+    seam)."""
+    from bcfl_tpu.analysis.concurrency import LockOrderChecker
+
+    c = LockOrderChecker()
+    for rel in ("dist/transport.py", "dist/runtime.py",
+                "telemetry/events.py"):
+        list(c.check(Source(os.path.join(BCFL, rel.replace("/", os.sep)))))
+    targets = {b for (_a, b) in c.edges}
+    assert "EventWriter._lock" in targets
+    assert not list(c.finalize())  # and the repo graph is cycle-free

@@ -9,6 +9,25 @@ import pytest
 from bcfl_tpu.entrypoints import build_presets, get_preset, list_presets, run
 
 
+def test_cli_lint_subcommand(capsys):
+    """`bcfl-tpu lint` dispatches before the run argparse (like trace):
+    --list-checkers prints the catalogue and exits 0, and the repo-wide
+    default run is the ANALYSIS.md standing guard (exit 0 == zero
+    unsuppressed findings)."""
+    from bcfl_tpu.entrypoints.__main__ import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", "--list-checkers"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for cid in ("guarded-by", "lock-order", "determinism",
+                "telemetry-schema", "socket-deadline", "no-frame-concat"):
+        assert cid in out
+    with pytest.raises(SystemExit) as exc:
+        main(["lint"])  # default paths: the installed package
+    assert exc.value.code == 0, capsys.readouterr().out
+
+
 def test_preset_matrix():
     p = build_presets()
     assert len(p) >= 13

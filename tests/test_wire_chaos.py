@@ -727,29 +727,21 @@ def test_send_async_under_wire_chaos_dedup_and_drop():
 
 def test_every_dist_socket_op_has_a_deadline():
     """Static guard for the PR 7 invariant 'hard deadlines everywhere':
-    every socket recv/accept/connect call site under bcfl_tpu/dist must
-    carry a timeout (a ``timeout``/``settimeout`` within the surrounding
-    lines, or an explicit ``# deadline:`` pointer to where it is
-    enforced). A new call site without one fails HERE, not as a wedged
-    peer in CI."""
-    patterns = (".accept(", ".recv(", "create_connection(", ".connect(")
-    offenders = []
-    dist_dir = os.path.join(REPO, "bcfl_tpu", "dist")
-    for fname in sorted(os.listdir(dist_dir)):
-        if not fname.endswith(".py"):
-            continue
-        path = os.path.join(dist_dir, fname)
-        with open(path) as f:
-            lines = f.read().splitlines()
-        for i, line in enumerate(lines):
-            code = line.split("#", 1)[0]
-            if not any(p in code for p in patterns):
-                continue
-            # a call may wrap: the timeout kwarg can sit a couple of
-            # lines below the opening paren
-            window = lines[max(0, i - 3):i + 4]
-            if not any("timeout" in w or "deadline:" in w for w in window):
-                offenders.append(f"{fname}:{i + 1}: {line.strip()}")
+    every socket recv/recv_into/accept/connect call site under
+    bcfl_tpu/dist must carry a visible deadline. A new call site without
+    one fails HERE, not as a wedged peer in CI. Now a thin wrapper over
+    the AST ``socket-deadline`` checker (bcfl_tpu.analysis, ANALYSIS.md),
+    which resolves the actual call and its keyword args instead of the
+    old ±3-line substring window — and covers ``recv_into``, which the
+    substrings never matched; tests/test_analysis.py pins grep parity."""
+    from bcfl_tpu.analysis import run_lint
+
+    offenders = [
+        f"{os.path.basename(f.file)}:{f.line}: {f.message}"
+        for f in run_lint([os.path.join(REPO, "bcfl_tpu", "dist")],
+                          checker_ids_filter=["socket-deadline"],
+                          use_baseline=False)
+        if f.failing]
     assert not offenders, (
         "socket call sites without a visible deadline "
         "(add a timeout or a '# deadline: ...' pointer):\n"
@@ -763,25 +755,17 @@ def test_no_full_frame_payload_concat_outside_wire():
     production code, and nothing under ``bcfl_tpu/dist`` may ``b"".join``
     a payload. A regression here silently doubles peak serialization
     memory per send (a model-sized copy), exactly what the streaming
-    writer (``wire.write_frame``) exists to avoid."""
-    offenders = []
+    writer (``wire.write_frame``) exists to avoid. Now a thin wrapper
+    over the AST ``no-frame-concat`` checker (bcfl_tpu.analysis,
+    ANALYSIS.md), which flags real call sites instead of substrings."""
+    from bcfl_tpu.analysis import run_lint
+
     pkg = os.path.join(REPO, "bcfl_tpu")
-    for dirpath, _, files in os.walk(pkg):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, pkg)
-            if rel == os.path.join("dist", "wire.py"):
-                continue  # the reference implementation lives here
-            with open(path) as f:
-                lines = f.read().splitlines()
-            for i, line in enumerate(lines):
-                code = line.split("#", 1)[0]
-                if "pack_frame(" in code:
-                    offenders.append(f"{rel}:{i + 1}: {line.strip()}")
-                if (rel.startswith("dist") and 'b"".join' in code):
-                    offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+    offenders = [
+        f"{os.path.relpath(f.file, pkg)}:{f.line}: {f.message}"
+        for f in run_lint([pkg], checker_ids_filter=["no-frame-concat"],
+                          use_baseline=False)
+        if f.failing]
     assert not offenders, (
         "full-frame payload concatenation outside wire.py (stream via "
         "wire.write_frame instead):\n" + "\n".join(offenders))

@@ -227,6 +227,22 @@ class DistConfig:
 # --- runtime capability table (RUNTIME.md §2) --------------------------------
 # Every (feature x runtime) combination is either SUPPORTED or rejected by
 # this one declared table — the single capability check the acceptance
+def parse_lora_ranks(spec: str) -> Tuple[int, ...]:
+    """Parse a ``lora_ranks`` spec ("2,4,8") into a tuple of positive ints.
+    The spec is cycled over the stacked client axis: client ``i`` trains at
+    ``spec[i % len(spec)]``. Raises with the offending token on bad input."""
+    try:
+        ranks = tuple(int(tok) for tok in spec.split(","))
+    except ValueError:
+        raise ValueError(
+            f"lora_ranks must be comma-separated positive ints "
+            f"(e.g. '2,4,8'), got {spec!r}")
+    if not ranks or any(r <= 0 for r in ranks):
+        raise ValueError(
+            f"lora_ranks entries must all be > 0, got {spec!r}")
+    return ranks
+
+
 # contract names. Each row is ``(feature, active, {runtime: verdict})``:
 # ``active(cfg)`` says whether the feature is requested, a ``True`` verdict
 # means the runtime supports it, and a string verdict is the rejection
@@ -367,6 +383,21 @@ RUNTIME_CAPS: Tuple = (
       "dist": "per-round central eval would serialize the async runtime "
               "behind the leader; set eval_every=0 — the leader "
               "evaluates the final global once at shutdown"}),
+    ("LoRA adapter exchange",
+     lambda c: c.lora_rank > 0 or bool(c.lora_ranks),
+     {"local": True, "dist": True}),  # dist: with lora_rank > 0 the
+    # trainable tree IS the adapter tree, so update/broadcast frames,
+    # leader refingerprint, robust merge votes, byzantine evidence, and
+    # HELLO/checkpoint resync all carry KB-scale adapter payloads — the
+    # full-model frame never crosses the wire (RUNTIME.md, COMPRESSION.md
+    # "Adapter exchange"; gated by scripts/lora_comm.py)
+    ("heterogeneous LoRA ranks",
+     lambda c: bool(c.lora_ranks) and len(set(parse_lora_ranks(c.lora_ranks))) > 1,
+     {"local": True,
+      "dist": "each dist peer compiles round programs over its own client "
+              "slice; the rank-aware padded aggregation (RBLA) is defined "
+              "over the single-process stacked client axis — use a uniform "
+              "lora_rank"}),
 )
 
 
@@ -411,6 +442,16 @@ class FedConfig:
     model: str = "tiny-bert"  # key into bcfl_tpu.models registry
     hf_checkpoint: Optional[str] = None  # e.g. "albert-base-v2" to import weights
     lora_rank: int = 0  # 0 = full fine-tune (reference behaviour); >0 = LoRA
+    # per-client LoRA rank spec for HETEROGENEOUS fleets (RBLA, arXiv
+    # 2408.08699): comma-separated ints cycled over the stacked client axis
+    # — "2,4,8" means client i trains at rank spec[i % 3]. Mutually
+    # exclusive with lora_rank; __post_init__ canonicalizes lora_rank to
+    # max(spec) so every existing `lora_rank > 0` switch (adapter-tree
+    # trainable, tp gating, dist adapter wire) sees the cohort ceiling.
+    # Clients are materialized zero-padded at that max rank; the padding
+    # mask is static in this spec, so heterogeneous fleets add zero
+    # per-round retraces. "" = uniform (lora_rank applies to everyone).
+    lora_ranks: str = ""
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     # None = the model family's default (llama: flash on from seq 512;
@@ -600,6 +641,16 @@ class FedConfig:
     telemetry_sample: float = 1.0
 
     def __post_init__(self):
+        if self.lora_ranks:
+            spec = parse_lora_ranks(self.lora_ranks)  # validates the spec
+            if self.lora_rank > 0:
+                raise ValueError(
+                    "set lora_ranks OR lora_rank, not both: lora_ranks is "
+                    "the per-client spec and canonicalizes lora_rank to "
+                    "max(spec)")
+            # canonicalize BEFORE the capability walk so every existing
+            # `lora_rank > 0` switch sees the cohort max rank
+            object.__setattr__(self, "lora_rank", max(spec))
         if self.runtime not in ("local", "dist"):
             raise ValueError(f"unknown runtime: {self.runtime!r}")
         if self.mode not in ("server", "serverless"):
@@ -774,6 +825,36 @@ class FedConfig:
                 "tp > 1 tensor-shards the FROZEN base and keeps per-client "
                 "LoRA adapters; set lora_rank > 0 (full fine-tune is 1-D "
                 "clients-only)")
+        if self.lora_ranks and len(set(parse_lora_ranks(self.lora_ranks))) > 1:
+            # heterogeneous ranks: the stacked adapter tree carries
+            # STRUCTURAL zero padding per client (models/lora.py), and only
+            # the rank-aware RBLA mean knows which coordinates are padding
+            if self.aggregator != "mean":
+                raise ValueError(
+                    f"aggregator={self.aggregator!r} does not compose with "
+                    "heterogeneous lora_ranks: order statistics have no "
+                    "sound definition over structural zero padding (a "
+                    "low-rank client's padded coordinate would vote an "
+                    "exact 0 into every trim/median/krum decision) — use "
+                    "aggregator='mean' (the rank-aware RBLA rule)")
+            if self.mode != "server":
+                raise ValueError(
+                    "heterogeneous lora_ranks require mode='server': ring "
+                    "gossip mixes whole neighbor trees, and the rank-aware "
+                    "padded aggregation (RBLA) has no per-edge ring form")
+            if self.faithful:
+                raise ValueError(
+                    "heterogeneous lora_ranks are not implemented for "
+                    "faithful (host-sequential) mode — it averages host-"
+                    "side with the reference's plain mean, which would "
+                    "dilute low-rank clients' padded coordinates")
+            if self.registry_size > 0:
+                raise ValueError(
+                    "heterogeneous lora_ranks do not compose with registry "
+                    "sampling: ranks are cycled over the FIXED stacked "
+                    "client slots, while sampling re-deals which registry "
+                    "client sits in each slot every round — a client's "
+                    "rank would change under it")
         if self.async_buffer < 0:
             raise ValueError(
                 f"async_buffer must be >= 0, got {self.async_buffer}")
@@ -857,6 +938,22 @@ class FedConfig:
         explicit spelling. None passes through (jax's process default)."""
         return ("threefry2x32" if self.prng_impl == "threefry"
                 else self.prng_impl)
+
+    @property
+    def lora_rank_spec(self) -> Optional[Tuple[int, ...]]:
+        """Parsed ``lora_ranks`` tuple, or None when unset (uniform rank)."""
+        return parse_lora_ranks(self.lora_ranks) if self.lora_ranks else None
+
+    @property
+    def client_lora_ranks(self) -> Optional[Tuple[int, ...]]:
+        """Per-client rank assignment — the spec cycled over the stacked
+        client axis (length ``num_clients``), or None when uniform. This
+        tuple is the static input to the padding mask and the program-cache
+        key, so same spec + same fleet = same compiled program."""
+        spec = self.lora_rank_spec
+        if spec is None:
+            return None
+        return tuple(spec[i % len(spec)] for i in range(self.num_clients))
 
     def replace(self, **kw) -> "FedConfig":
         return dataclasses.replace(self, **kw)

@@ -106,6 +106,11 @@ def main(argv=None):
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--lr", type=float, default=None)
     ap.add_argument("--lora-rank", type=int, default=None)
+    ap.add_argument("--lora-ranks", type=str, default=None,
+                    help="heterogeneous per-client adapter ranks, e.g. "
+                         "'2,4,8' cycled over clients (RBLA aggregation; "
+                         "COMPRESSION.md 'Adapter exchange'). Exclusive "
+                         "with --lora-rank")
     ap.add_argument("--max-local-batches", type=int, default=None)
     # cohort-batched client scale-out (SCALING.md "Cohort mode"): simulate
     # a registry far larger than the mesh; a seeded sampler draws each
@@ -375,6 +380,7 @@ def main(argv=None):
         "dataset": "dataset", "mode": "mode", "sync": "sync", "task": "task",
         "seq_len": "seq_len", "batch_size": "batch_size",
         "lr": "learning_rate", "lora_rank": "lora_rank",
+        "lora_ranks": "lora_ranks",
         "max_local_batches": "max_local_batches", "seed": "seed",
         "registry_size": "registry_size", "sample_clients": "sample_clients",
         "cohort_size": "cohort_size",
@@ -389,6 +395,12 @@ def main(argv=None):
         v = getattr(args, arg_name)
         if v is not None:
             overrides[cfg_name] = v
+    if args.lora_ranks is not None and args.lora_rank is None:
+        # a per-client spec supersedes a preset's uniform rank (FedConfig
+        # rejects setting both and re-canonicalizes lora_rank to max(spec));
+        # an EXPLICIT --lora-rank alongside --lora-ranks still reaches
+        # FedConfig and fails there with its clear set-one-not-both message
+        overrides["lora_rank"] = 0
     if args.model is not None and cfg.hf_checkpoint is not None:
         # keep checkpoint/tokenizer consistent with the overridden architecture
         if args.model not in _HF:

@@ -327,9 +327,22 @@ def build_programs(
     # Parity between them is pinned by tests/test_gspmd_impl.py. Override the
     # default with BCFL_FED_IMPL.
     impl: str = "auto",
+    # per-client LoRA rank tuple (FedConfig.client_lora_ranks) for
+    # HETEROGENEOUS fleets: every client is materialized zero-padded at
+    # max(lora_ranks), the [C, R] padding mask compiles in as a closure
+    # constant (static in this tuple — part of the cache key below, zero
+    # per-round retraces), locals are clipped to their own rank at
+    # train entry, and every 'mean' aggregation point becomes the
+    # rank-aware RBLA rule (gspmd.rank_aware_weighted_mean). None or a
+    # uniform tuple builds EXACTLY the plain programs.
+    lora_ranks: Optional[tuple] = None,
 ) -> FedPrograms:
     if impl == "auto":
         impl = os.environ.get("BCFL_FED_IMPL", "gspmd")
+    if lora_ranks is not None and len(set(lora_ranks)) <= 1:
+        # uniform spec == plain build: the all-ones clip would be a
+        # different (wastefully retraced) program computing the identity
+        lora_ranks = None
     if compression is not None and not compression.enabled:
         # normalize so compress='none' and no-compression callers share ONE
         # cache entry — they are the same programs by construction (the
@@ -355,7 +368,7 @@ def build_programs(
         # mesh field, including any added later that changes program layout
         key = (model, mesh, optimizer, learning_rate, max_grad_norm,
                gossip_alpha, gossip_steps, task, aggregator, aggregator_trim,
-               prng_impl, donate, impl, compression, hierarchical)
+               prng_impl, donate, impl, compression, hierarchical, lora_ranks)
         hash(key)
     except TypeError:
         key = None
@@ -369,7 +382,7 @@ def build_programs(
         gossip_steps=gossip_steps, donate=donate, task=task,
         aggregator=aggregator, aggregator_trim=aggregator_trim,
         prng_impl=prng_impl, compression=compression,
-        hierarchical=hierarchical, impl=impl)
+        hierarchical=hierarchical, impl=impl, lora_ranks=lora_ranks)
     if key is not None:
         while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
             # FIFO eviction bounds the compiled-executable footprint over a
@@ -406,6 +419,7 @@ def _build_programs_dispatch(
     donate: bool,
     hierarchical: bool,
     impl: str,
+    lora_ranks: Optional[tuple] = None,
 ) -> FedPrograms:
     if impl == "gspmd":
         return _build_programs_gspmd(
@@ -414,9 +428,17 @@ def _build_programs_dispatch(
             gossip_steps=gossip_steps, donate=donate, task=task,
             aggregator=aggregator, aggregator_trim=aggregator_trim,
             prng_impl=prng_impl, compression=compression,
-            hierarchical=hierarchical)
+            hierarchical=hierarchical, lora_ranks=lora_ranks)
     if impl != "shard_map":
         raise ValueError(f"unknown fed impl {impl!r}")
+    if lora_ranks is not None:
+        # the rank-aware RBLA aggregation is global-array math over the full
+        # stacked client dim (per-rank-dim normalization needs every
+        # client's mask row at once); the manual-SPMD twin has no form of it
+        raise ValueError(
+            "heterogeneous lora_ranks require impl='gspmd' (unset "
+            "BCFL_FED_IMPL or set it to 'gspmd'); the shard_map twin has no "
+            "rank-aware aggregation and would dilute low-rank clients")
     if hierarchical:
         # the explicit two-level reduction is global-array math over the
         # full stacked client dim — the manual-SPMD twin would need its own
@@ -738,6 +760,7 @@ def _build_programs_gspmd(
     prng_impl: Optional[str] = None,
     compression: Optional[CompressionConfig] = None,
     hierarchical: bool = False,
+    lora_ranks: Optional[tuple] = None,
 ) -> FedPrograms:
     """GSPMD twin of the shard_map builder: identical program signatures and
     semantics (global stacked-client arrays in, global arrays out), but the
@@ -771,8 +794,15 @@ def _build_programs_gspmd(
     # within-device-stack then cross-device reduction; groups = the mesh's
     # clients-axis extent, so each inner group IS one device's cohort slice
     groups = int(mesh.mesh.shape[mesh.axis]) if hierarchical else 0
+    # heterogeneous LoRA ranks: the [C, R] padding mask is a CLOSURE
+    # CONSTANT derived from the static rank tuple — it compiles into every
+    # program below (clipped train entry, RBLA aggregation, clipped codec
+    # deltas), so which client trains at which rank never retraces
+    rmask = (None if lora_ranks is None
+             else lora_lib.rank_mask(lora_ranks))
     agg = gspmd.make_aggregator(aggregator, aggregator_trim,
-                                hierarchical_groups=groups)
+                                hierarchical_groups=groups,
+                                rank_mask=rmask)
     tx = make_optimizer(optimizer, learning_rate, max_grad_norm)
     loss_fn = make_loss_fn(model, task)
     unstack = lambda r: _unstack_rng(r, prng_impl)  # noqa: E731
@@ -788,11 +818,23 @@ def _build_programs_gspmd(
     def _don(*idx):
         return idx if donate else ()
 
-    # every client trains from the same replicated trainable
+    # every client trains from the same replicated trainable. Heterogeneous
+    # ranks clip the replicated global to EACH client's own rank at train
+    # entry (a low-rank client never sees the fleet's higher-rank
+    # components); both factors of a padded dim enter at exactly 0, so
+    # grads there are 0 and AdamW keeps them exactly 0 through the round —
+    # no post-aggregation re-clip is needed on any path.
     def train_clients(global_t, frozen, batches, rngs):
-        new_t, stats = jax.vmap(
-            lambda b, r: local_train(global_t, frozen, b, unstack(r))
-        )(batches, rngs)
+        if rmask is None:
+            new_t, stats = jax.vmap(
+                lambda b, r: local_train(global_t, frozen, b, unstack(r))
+            )(batches, rngs)
+        else:
+            new_t, stats = jax.vmap(
+                lambda mrow, b, r: local_train(
+                    lora_lib.clip_adapters(global_t, mrow), frozen, b,
+                    unstack(r))
+            )(rmask, batches, rngs)
         return _c(new_t, cl), _c(stats, cl)
 
     def server_body(global_t, frozen, batches, weights, rngs):
@@ -855,6 +897,13 @@ def _build_programs_gspmd(
         delta = jax.tree.map(
             lambda n, g: n.astype(jnp.float32) - g.astype(jnp.float32),
             new_t, ref_t)
+        if rmask is not None:
+            # a client's delta on its PADDING dims is -ref there (its local
+            # is structurally 0, the global needn't be): those dims aren't
+            # the client's to ship — clip them so the codec budget (top-k
+            # slots, quantization range) is spent on real coordinates and
+            # the EF residual stays exactly 0 on padding
+            delta = jax.vmap(lora_lib.clip_adapters)(delta, rmask)
         payload, dec, resid = cc.roundtrip(comp, delta, resid, _ckey(rngs))
         return _c(payload, cl), dec, _c(resid, cl)
 
@@ -957,11 +1006,19 @@ def _build_programs_gspmd(
         return gspmd.gossip_mix_recv(self_t, recv_t, mask, gossip_alpha,
                                      steps=gossip_steps)
 
-    # each client trains from its OWN stacked params
+    # each client trains from its OWN stacked params (same per-client rank
+    # clip at entry as train_clients — an adopted global's higher-rank
+    # components are chopped before a low-rank client optimizes)
     def local_updates_body(client_t, frozen, batches, rngs):
-        new_t, stats = jax.vmap(
-            lambda t, b, r: local_train(t, frozen, b, unstack(r))
-        )(client_t, batches, rngs)
+        if rmask is None:
+            new_t, stats = jax.vmap(
+                lambda t, b, r: local_train(t, frozen, b, unstack(r))
+            )(client_t, batches, rngs)
+        else:
+            new_t, stats = jax.vmap(
+                lambda mrow, t, b, r: local_train(
+                    lora_lib.clip_adapters(t, mrow), frozen, b, unstack(r))
+            )(rmask, client_t, batches, rngs)
         return _c(new_t, cl), _c(stats, cl)
 
     def gossip_body(client_t, frozen, batches, mask, rngs):

@@ -336,9 +336,25 @@ class FedEngine:
             from bcfl_tpu.models import lora_targets
 
             self.frozen = params
-            self.trainable0 = lora_lib.init_lora(
-                jax.random.fold_in(self.root_key, 3), params, cfg.lora_rank,
-                targets=lora_targets(cfg.model))
+            ranks = cfg.client_lora_ranks
+            if ranks is not None and len(set(ranks)) > 1:
+                # heterogeneous fleet: each client's adapters initialize AT
+                # ITS OWN rank (own gaussian/sqrt(r_c) scale), zero-padded
+                # to the cohort max; the round-0 global is their RBLA mean
+                # (b starts at zeros everywhere, so the collapse only
+                # blends the per-rank-normalized 'a' factors)
+                from bcfl_tpu.parallel import gspmd
+
+                stacked0 = lora_lib.init_lora_ranks(
+                    jax.random.fold_in(self.root_key, 3), params, ranks,
+                    targets=lora_targets(cfg.model))
+                self.trainable0 = gspmd.rank_aware_weighted_mean(
+                    stacked0, jnp.ones((len(ranks),), jnp.float32),
+                    lora_lib.rank_mask(ranks))
+            else:
+                self.trainable0 = lora_lib.init_lora(
+                    jax.random.fold_in(self.root_key, 3), params,
+                    cfg.lora_rank, targets=lora_targets(cfg.model))
         else:
             self.frozen = None
             self.trainable0 = params
@@ -378,7 +394,16 @@ class FedEngine:
             # aggregation point (SCALING.md); normalized away for robust
             # aggregators, whose order statistics stay global
             hierarchical=self.sampling,
+            # heterogeneous LoRA ranks: the per-client tuple is part of the
+            # program-cache key; build_programs normalizes a uniform tuple
+            # (or None) to the plain programs
+            lora_ranks=cfg.client_lora_ranks,
         )
+        # per-round rank-collapse guard (arXiv 2602.13486): mean effective
+        # rank of the global adapter tree, one tiny separate jit (compiles
+        # once — the round programs stay untouched); None when LoRA is off
+        self._eff_rank = (jax.jit(lora_lib.effective_rank)
+                          if cfg.lora_rank > 0 else None)
         # communication compression (COMPRESSION.md): None when disabled.
         # The error-feedback residual (stacked [C, ...] f32) is engine round
         # state, lazily initialized in _run and checkpointed — crash/resume
@@ -1029,6 +1054,17 @@ class FedEngine:
                             f"{ck_comp!r} but this run has {here!r}: "
                             "resuming would change the wire format under "
                             "the carried error-feedback state")
+                ck_lora = state.get("lora_format")
+                if ck_lora is not None:
+                    ck_lora = bytes(np.asarray(ck_lora, np.uint8)).decode()
+                    here = self._lora_format()
+                    if ck_lora != here:
+                        raise ValueError(
+                            f"checkpoint was written with LoRA layout "
+                            f"{ck_lora!r} but this run has {here!r}: "
+                            "resuming would reinterpret the checkpointed "
+                            "adapter (and error-feedback) trees under a "
+                            "different rank layout")
                 ck_seed = state.get("seed")
                 if ck_seed is not None and int(ck_seed) != cfg.seed:
                     raise ValueError(
@@ -1148,6 +1184,10 @@ class FedEngine:
                         stacked, trainable, recs = self._serverless_chunk(
                             rnd, stacked, trainable, chunk)
                 self._annotate_chunk(recs, time.time() - t0)
+                if self._eff_rank is not None and recs:
+                    # fused dispatch: only the chunk's FINAL global exists
+                    # host-side; the guard statistic lands on its record
+                    recs[-1].effective_rank = float(self._eff_rank(trainable))
                 last_rnd = rnd + chunk - 1
                 self._maybe_eval(last_rnd, recs[-1], trainable, stacked, clock)
                 metrics.rounds.extend(recs)
@@ -1279,6 +1319,8 @@ class FedEngine:
             rec.info_passing_sync_s = sync_t
             rec.info_passing_async_s = async_t
             rec.wall_s = time.time() - t0
+            if self._eff_rank is not None:
+                rec.effective_rank = float(self._eff_rank(trainable))
 
             if self.reputation is not None:
                 # evidence folds in BEFORE eval/checkpoint so the
@@ -1347,6 +1389,19 @@ class FedEngine:
             s = np.asarray(s)
             rec.local_acc = (s[:, 1] / np.maximum(s[:, 2], 1)).tolist()
 
+    def _lora_format(self) -> str:
+        """Checkpoint identity of the LoRA layout: ``full`` (no adapters),
+        ``r<k>`` uniform, or the per-client spec ``ranks:2,4,8,...``. Like
+        ``compress_format``, a change across resume would silently
+        reinterpret the restored trainable/EF trees — resume refuses it."""
+        cfg = self.cfg
+        if cfg.lora_rank <= 0:
+            return "full"
+        ranks = cfg.client_lora_ranks
+        if ranks is None or len(set(ranks)) <= 1:
+            return f"r{cfg.lora_rank}"
+        return "ranks:" + ",".join(str(r) for r in ranks)
+
     def _maybe_checkpoint(self, rnd: int, trainable, stacked) -> None:
         cfg = self.cfg
         if not (cfg.checkpoint_dir and cfg.checkpoint_every
@@ -1381,6 +1436,12 @@ class FedEngine:
             # distinguishes same-width impls (rbg vs unsafe_rbg)
             "prng_impl_name": np.frombuffer(
                 self._prng_name.encode(), np.uint8).copy(),
+            # LoRA rank identity, uint8-encoded ("r<uniform>" or the
+            # per-client spec): resuming under a different rank layout
+            # would reinterpret the checkpointed adapter (and EF) trees —
+            # resume refuses a mismatch (below)
+            "lora_format": np.frombuffer(
+                self._lora_format().encode(), np.uint8).copy(),
         }
         if self.reputation is not None:
             # rep_trust / rep_state / rep_timer / counters: the peer

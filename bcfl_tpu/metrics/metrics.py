@@ -128,6 +128,12 @@ class RoundRecord:
     bytes_raw: Optional[float] = None
     bytes_on_wire: Optional[float] = None
     compression_ratio: Optional[float] = None
+    # LoRA adapter exchange: mean Shannon effective rank of the global
+    # adapter tree after this round's aggregation — the rank-collapse guard
+    # for heterogeneous-rank fleets (a healthy RBLA aggregate keeps energy
+    # spread across rank dims; a collapsing one trends toward 1.0). None
+    # when lora_rank == 0.
+    effective_rank: Optional[float] = None
     wall_s: float = 0.0
     # True when this round ran inside a fused multi-round dispatch: wall_s
     # is then the chunk total split EVENLY across its rounds (an

@@ -96,3 +96,102 @@ def apply_lora(params, adapters, scale: float = 1.0):
 
 def num_params(tree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous per-client ranks (RBLA, arXiv 2408.08699).
+#
+# A fleet where client c trains at rank r_c is materialized at the COHORT MAX
+# rank R = max(r_c): every 'a' is [fan_in, R], every 'b' is [R, fan_out], and
+# client c's columns/rows >= r_c are structural zero padding. The padding is
+# described by a [C, R] mask that is a pure function of the (static) rank
+# spec — it compiles into the round programs as a closure constant, so
+# heterogeneous fleets add ZERO per-round retraces. Padding stays exactly
+# zero through training without re-clipping after aggregation: both factors
+# start at 0 there, so gradients are 0, and AdamW (m=0, v=0, decay of a 0
+# param) produces an exactly-0 update — clipping the global tree once at
+# local-train entry covers every path (server, serverless, async, gossip).
+# ---------------------------------------------------------------------------
+
+
+def rank_mask(ranks: Sequence[int]) -> jnp.ndarray:
+    """``[C, max(ranks)]`` float mask: ``mask[c, j] = 1`` iff ``j < ranks[c]``.
+    Static in the rank spec — built once at program-build time."""
+    r = jnp.asarray([int(x) for x in ranks], jnp.int32)
+    rmax = int(max(int(x) for x in ranks))
+    return (jnp.arange(rmax)[None, :] < r[:, None]).astype(jnp.float32)
+
+
+def clip_adapters(adapters, mask_row: jnp.ndarray):
+    """Zero one client's padding dims: ``a * row[None, :]``,
+    ``b * row[:, None]``; ``full`` head leaves pass through. Applied to the
+    replicated global tree at local-train entry (vmapped over mask rows)."""
+
+    def clip(path, leaf):
+        names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
+                      for p in path)
+        last = names[-1] if names else ""
+        if last == "a":
+            return leaf * mask_row[None, :].astype(leaf.dtype)
+        if last == "b":
+            return leaf * mask_row[:, None].astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(clip, adapters)
+
+
+def init_lora_ranks(key: jax.Array, params, ranks: Sequence[int],
+                    targets: Sequence[str] = DEFAULT_TARGETS,
+                    head_modules: Sequence[str] = HEAD_MODULES):
+    """Stacked ``[C, ...]`` adapter tree for a heterogeneous fleet: client
+    ``c`` is initialized AT ITS OWN rank (gaussian/sqrt(r_c) — the init
+    scale a homogeneous rank-r_c client would get), then zero-padded to the
+    cohort max rank so all clients share one stacked structure."""
+    ranks = tuple(int(r) for r in ranks)
+    rmax = max(ranks)
+    per_client = []
+    for c, r in enumerate(ranks):
+        adp = init_lora(jax.random.fold_in(key, c), params, r,
+                        targets=targets, head_modules=head_modules)
+        padded = {}
+        for k, entry in adp.items():
+            if "full" in entry:
+                padded[k] = entry
+            else:
+                padded[k] = {
+                    "a": jnp.pad(entry["a"], ((0, 0), (0, rmax - r))),
+                    "b": jnp.pad(entry["b"], ((0, rmax - r), (0, 0))),
+                }
+        per_client.append(padded)
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_client)
+
+
+def effective_rank(adapters) -> jnp.ndarray:
+    """Mean Shannon effective rank over the adapter factor pairs of one
+    (unstacked) adapter tree — the rank-collapse guard of arXiv 2602.13486,
+    without an SVD: per rank dim ``e_j = ||a[:, j]||^2 * ||b[j, :]||^2`` is
+    the squared Frobenius energy of the j-th rank-1 component, and
+    ``exp(entropy(e / sum e))`` counts how many components carry it. 0.0
+    when the adapters carry no energy at all (b starts at zeros)."""
+    tiny = jnp.float32(1e-30)
+    effs = []
+    flat = jax.tree_util.tree_flatten_with_path(adapters)[0]
+    pairs = {}
+    for path, leaf in flat:
+        names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
+                      for p in path)
+        if names and names[-1] in ("a", "b"):
+            pairs.setdefault("/".join(names[:-1]), {})[names[-1]] = leaf
+    for entry in pairs.values():
+        if "a" not in entry or "b" not in entry:
+            continue
+        a = entry["a"].astype(jnp.float32)
+        b = entry["b"].astype(jnp.float32)
+        e = (a * a).sum(axis=0) * (b * b).sum(axis=1)
+        tot = e.sum()
+        p = e / jnp.maximum(tot, tiny)
+        ent = -(p * jnp.log(jnp.maximum(p, tiny))).sum()
+        effs.append(jnp.where(tot > tiny, jnp.exp(ent), 0.0))
+    if not effs:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.stack(effs).mean()

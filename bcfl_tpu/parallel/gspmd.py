@@ -86,6 +86,57 @@ def hierarchical_weighted_mean(tree: Tree, weights: jnp.ndarray, groups: int,
     return jax.tree.map(leaf_mean, tree, fallback)
 
 
+def rank_aware_weighted_mean(tree: Tree, weights: jnp.ndarray,
+                             rank_mask: jnp.ndarray,
+                             fallback: Optional[Tree] = None) -> Tree:
+    """RBLA-style weighted mean over a heterogeneous-rank stacked adapter
+    tree (arXiv 2408.08699): every client is materialized zero-padded at
+    the cohort max rank R, and ``rank_mask`` [C, R] (1 iff rank dim j is
+    REAL for client c — a static closure constant built from the rank spec)
+    marks which coordinates are structural padding. Per rank dim j, factor
+    leaves average only over the clients that cover j, normalized by THEIR
+    weight sum — so a low-rank client's padding never votes, and a
+    high-rank client's extra dims aren't diluted toward zero by the fleet's
+    low-rank majority (the naive mean's rank-collapse mechanism,
+    arXiv 2602.13486). ``a`` leaves are [C, fan_in, R] (mask on the last
+    axis), ``b`` leaves [C, R, fan_out] (mask on axis 1); ``full`` head
+    leaves and anything unrecognized take the plain weighted mean. Rank
+    dims NO participating client covers this round keep ``fallback``
+    (the previous global — same all-masked semantics as
+    :func:`masked_weighted_mean`, applied per dim)."""
+    den_all = weights.sum()
+    empty = den_all <= EPS
+    R = int(rank_mask.shape[1])
+
+    def leaf(path, x, fb):
+        names = tuple(getattr(p, "key", getattr(p, "name", str(p)))
+                      for p in path)
+        last = names[-1] if names else ""
+        fb_v = x.mean(axis=0) if fb is None else fb
+        if last == "a" and x.ndim == 3 and x.shape[-1] == R:
+            w = (weights[:, None] * rank_mask).astype(x.dtype)   # [C, R]
+            num = jnp.einsum("cj,cfj->fj", w, x)
+            den = w.sum(axis=0)                                  # [R]
+            mean = num / jnp.maximum(den, EPS)[None, :]
+            mean = jnp.where(den[None, :] > EPS, mean, fb_v)
+            return jnp.where(empty, fb_v, mean)
+        if last == "b" and x.ndim == 3 and x.shape[1] == R:
+            w = (weights[:, None] * rank_mask).astype(x.dtype)
+            num = jnp.einsum("cj,cjf->jf", w, x)
+            den = w.sum(axis=0)
+            mean = num / jnp.maximum(den, EPS)[:, None]
+            mean = jnp.where(den[:, None] > EPS, mean, fb_v)
+            return jnp.where(empty, fb_v, mean)
+        wl = weights.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        mean = (wl * x).sum(axis=0) / jnp.maximum(den_all, EPS).astype(x.dtype)
+        return jnp.where(empty, fb_v, mean)
+
+    if fallback is None:
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: leaf(p, x, None), tree)
+    return jax.tree_util.tree_map_with_path(leaf, tree, fallback)
+
+
 # ---------------------------------------------------------------------------
 # Byzantine-robust aggregation rules (ROBUSTNESS.md).
 #
@@ -224,7 +275,8 @@ AGGREGATORS = ("mean", "trimmed_mean", "median", "krum")
 
 
 def make_aggregator(name: str, trim: float = 0.2,
-                    hierarchical_groups: int = 0):
+                    hierarchical_groups: int = 0,
+                    rank_mask: Optional[jnp.ndarray] = None):
     """``(tree, weights, fallback) -> tree`` aggregation closure for the
     round-program builders. ``mean`` keeps full weighted-FedAvg semantics;
     the robust rules treat ``weights`` as a participation mask only (see
@@ -235,7 +287,20 @@ def make_aggregator(name: str, trim: float = 0.2,
     mode). The robust rules ignore it: order statistics over the client dim
     are global by definition — a per-device trimmed mean of trimmed means
     is a DIFFERENT (weaker) estimator, so 'hierarchical trimmed_mean' would
-    be a label lying about its breakdown point."""
+    be a label lying about its breakdown point.
+
+    ``rank_mask`` [C, R] (heterogeneous LoRA ranks) swaps ``mean`` for the
+    rank-aware RBLA rule (:func:`rank_aware_weighted_mean`); FedConfig
+    rejects the robust rules for heterogeneous fleets at config time (order
+    statistics over structural zero padding are unsound), so pairing a mask
+    with any other rule raises here too."""
+    if rank_mask is not None:
+        if name != "mean":
+            raise ValueError(
+                f"rank-aware aggregation (heterogeneous LoRA ranks) is "
+                f"defined for the mean only, got aggregator {name!r}")
+        return lambda t, w, fb: rank_aware_weighted_mean(
+            t, w, rank_mask, fallback=fb)
     if name == "mean":
         if hierarchical_groups > 1:
             return lambda t, w, fb: hierarchical_weighted_mean(

@@ -45,6 +45,12 @@ BCFL_BENCH_COMPRESS={none,int8,topk,int8+topk} compiles the update-exchange
 codec (COMPRESSION.md) into the timed round program and adds bytes-on-wire
 fields to the JSON line — the throughput-per-codec axis of the
 scripts/tpu_perf.py --compress sweep.
+BCFL_BENCH_LORA_RANK=<r> (r > 0) makes the LoRA adapter the trainable /
+exchanged tree (COMPRESSION.md "Adapter exchange"): the timed program
+fine-tunes rank-r adapters over the frozen base, and every JSON line —
+local and dist mode — stamps lora_rank, the adapter param count, and the
+per-round adapter payload bytes (through the configured codec, so the
+axis composes with BCFL_BENCH_COMPRESS).
 """
 
 from __future__ import annotations
@@ -70,6 +76,10 @@ MODE = os.environ.get("BCFL_BENCH_MODE", "server")  # server | serverless
 # backend-init watchdog is armed; tests/test_compression.py pins the copies
 COMPRESS_KINDS = ("none", "int8", "topk", "int8+topk")
 COMPRESS = os.environ.get("BCFL_BENCH_COMPRESS", "none")
+# adapter-exchange axis: rank 0 = full-model fine-tune (the default row);
+# kept as a raw string here and validated in main() so a typo still dies
+# through _error_json under the one-JSON-line contract
+LORA_RANK_RAW = os.environ.get("BCFL_BENCH_LORA_RANK", "0")
 # opt-in event telemetry (OBSERVABILITY.md): a directory here makes the
 # bench stream run/phase events (bcfl_tpu.telemetry) into
 # events_bench.jsonl there, and every JSON line stamps `event_stream`
@@ -245,6 +255,8 @@ def _dist_bench(watchdog):
     model = os.environ.get("BCFL_BENCH_DIST_MODEL", "tiny-bert")
     clients_per_peer = int(os.environ.get("BCFL_BENCH_DIST_CLIENTS", "2"))
     pipeline = os.environ.get("BCFL_BENCH_DIST_PIPELINE", "1") != "0"
+    # validated in main() before this runs — re-read, like the knobs above
+    lora_rank = int(os.environ.get("BCFL_BENCH_LORA_RANK", "0") or "0")
     batch, seq, local_batches = 4, 16, 2
     deadline = float(os.environ.get("BCFL_BENCH_DIST_DEADLINE_S", "420"))
     cfg = FedConfig(
@@ -252,7 +264,7 @@ def _dist_bench(watchdog):
         model=model, dataset="synthetic",
         num_clients=peers * clients_per_peer, num_rounds=versions,
         seq_len=seq, batch_size=batch, max_local_batches=local_batches,
-        eval_every=0, seed=42,
+        eval_every=0, seed=42, lora_rank=lora_rank,
         partition=PartitionConfig(kind="iid", iid_samples=8),
         ledger=LedgerConfig(enabled=True),
         compression=CompressionConfig(kind=COMPRESS),
@@ -298,6 +310,31 @@ def _dist_bench(watchdog):
         "local_rounds_total": int(total_rounds),
         "wall_s": round(dt, 2),
     }
+    if lora_rank > 0:
+        # adapter accounting without spinning up a backend in the parent:
+        # eval_shape traces init + adapter construction on abstract arrays,
+        # and payload_nbytes is metadata-only, so the stamp is free
+        import jax
+        import jax.numpy as jnp
+
+        from bcfl_tpu.compression import payload_nbytes
+        from bcfl_tpu.models import build, lora as lora_lib, lora_targets
+
+        m = build(model, num_labels=2)
+        ids = jnp.ones((2, seq), jnp.int32)
+        pshapes = jax.eval_shape(
+            lambda k: m.init(k, ids, ids)["params"], jax.random.key(0))
+        ashapes = jax.eval_shape(
+            lambda p: lora_lib.init_lora(jax.random.key(1), p, lora_rank,
+                                         targets=lora_targets(model)),
+            pshapes)
+        comp = None if COMPRESS == "none" else CompressionConfig(
+            kind=COMPRESS)
+        out["lora_rank"] = lora_rank
+        out["adapter_params"] = int(sum(
+            x.size for x in jax.tree.leaves(ashapes)))
+        out["bytes_on_wire_per_round"] = int(
+            payload_nbytes(comp, ashapes) * cfg.num_clients)
     if keep:
         out["run_dir"] = run_dir
     else:
@@ -318,6 +355,14 @@ def main():
         # uncompressed program under a compression label
         _error_json("config", f"unknown BCFL_BENCH_COMPRESS {COMPRESS!r}; "
                     "expected none/int8/topk/int8+topk")
+        sys.exit(1)
+    try:
+        lora_rank = int(LORA_RANK_RAW or "0")
+        if lora_rank < 0:
+            raise ValueError
+    except ValueError:
+        _error_json("config", f"bad BCFL_BENCH_LORA_RANK {LORA_RANK_RAW!r}; "
+                    "expected a non-negative integer")
         sys.exit(1)
     watchdog.stage("backend-init", INIT_TIMEOUT_S)
 
@@ -405,6 +450,26 @@ def main():
         comp = _compress_cfg()
         progs = build_programs(model, mesh, donate=True, compression=comp)
 
+        # adapter-exchange axis: the adapter tree becomes the trainable /
+        # exchanged carry and the full params become the frozen base (arg 1
+        # of every round program — never donated, so one replicated copy
+        # serves the whole block)
+        frozen = None
+        trainable0 = params
+        adapter_params = None
+        if lora_rank > 0:
+            from bcfl_tpu.models import lora as lora_lib, lora_targets
+
+            watchdog.stage("lora-init")
+            trainable0 = jax.jit(lambda p: lora_lib.init_lora(
+                jax.random.key(1), p, lora_rank,
+                targets=lora_targets("bert-base")))(params)
+            trainable0 = jax.device_put(trainable0, mesh.replicated())
+            fence(trainable0)
+            frozen = params
+            adapter_params = sum(
+                x.size for x in jax.tree.leaves(trainable0))
+
         batches, weights, rngs = synthetic_round_inputs(
             mesh, steps=STEPS, batch=BATCH, seq=SEQ, vocab_size=30_000)
         # stack a round axis: [R, C, ...] (same data every round — this is a
@@ -424,20 +489,21 @@ def main():
                 lambda p: jax.tree.map(
                     lambda x: jnp.broadcast_to(
                         x[None], (num_clients,) + x.shape), p),
-                out_shardings=mesh.client_sharding())(params)
+                out_shardings=mesh.client_sharding())(trainable0)
             fence(carry)
             run_block = lambda c: progs.gossip_rounds(  # noqa: E731
-                c, None, rbatches, rweights, rrngs)[0]
+                c, frozen, rbatches, rweights, rrngs)[0]
         else:
-            carry = params
+            carry = trainable0
             run_block = lambda c: progs.server_rounds(  # noqa: E731
-                c, None, rbatches, rweights, rrngs)[0]
+                c, frozen, rbatches, rweights, rrngs)[0]
 
         if comp is not None:
             # compressed round programs carry (params, EF residual); the
-            # run_block's [0] then chains the whole tuple
+            # run_block's [0] then chains the whole tuple. The residual
+            # lives over the TRAINABLE tree — adapter-shaped under LoRA
             watchdog.stage("ef-init")
-            ef = progs.ef_init(params)
+            ef = progs.ef_init(trainable0)
             fence(ef)
             carry = (carry, ef)
 
@@ -507,18 +573,24 @@ def main():
         }
         if prng:
             out["prng"] = prng
-        if comp is not None or "BCFL_BENCH_COMPRESS" in os.environ:
+        if (comp is not None or "BCFL_BENCH_COMPRESS" in os.environ
+                or lora_rank > 0):
             # bytes-on-wire axis (COMPRESSION.md): one shipped update per
             # client per round, raw vs through the codec (an explicit
-            # compress=none run still records its raw baseline row)
+            # compress=none run still records its raw baseline row). Under
+            # the LoRA axis the exchanged unit is the adapter tree, so the
+            # payload is adapter-sized and the codec stacks on top
             from bcfl_tpu.compression import payload_nbytes
 
-            raw_b = payload_nbytes(None, params) * num_clients
-            wire_b = payload_nbytes(comp, params) * num_clients
+            raw_b = payload_nbytes(None, trainable0) * num_clients
+            wire_b = payload_nbytes(comp, trainable0) * num_clients
             out["compress"] = COMPRESS
             out["bytes_raw_per_round"] = int(raw_b)
             out["bytes_on_wire_per_round"] = int(wire_b)
             out["compression_ratio"] = round(raw_b / max(wire_b, 1), 2)
+        if lora_rank > 0:
+            out["lora_rank"] = lora_rank
+            out["adapter_params"] = int(adapter_params)
         if peak:
             out["mfu_pct"] = round(100.0 * flops / dt / (peak * n_dev), 2)
         # a rate above peak silicon is not a measurement, it is a broken

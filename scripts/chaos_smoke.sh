@@ -195,6 +195,39 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# LoRA wire-chaos leg (COMPRESSION.md §7 "Adapter exchange"): the same
+# runtime + wire lane with ADAPTER payloads on the socket (--lora-rank 2).
+# Gates: the run completes under drop+dup+reorder, every update frame
+# stays at adapter scale (< 1 MB vs ~12 MB full-model — the frame-size
+# cap), and the delivery-contract invariants are clean over the event
+# streams. The rank-aware hetero aggregation itself is pure jax math with
+# no seeded host randomness (no SEEDED_SCOPE entry needed); its
+# zero-retrace pin rides tests/test_lora_exchange.py and the cohort-style
+# cache_size check in scripts/lora_comm.py.
+echo
+echo "lora wire-chaos leg: 2 peers, adapter exchange under drop+dup+reorder"
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/dist_chaos.py --peers 2 --rounds 5 --legs wire \
+    --lora-rank 2 --wire-corrupt 0.0 --deadline 400 --idle-timeout 90 \
+    --out /tmp/bcfl_chaos_dist_lora.json
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -c "
+import json
+from bcfl_tpu.telemetry import collate_run
+leg = json.load(open('/tmp/bcfl_chaos_dist_lora.json'))['legs']['wire']
+col = collate_run(leg['run_dir'])
+frames = [e['bytes'] for e in col['ordered']
+          if e['ev'] == 'send' and e.get('ok') and e.get('type') == 'update']
+assert frames, 'no update frames observed'
+print('lora leg: %d update frames, max %d B, invariants %s'
+      % (len(frames), max(frames), 'CLEAN' if col['ok'] else 'VIOLATED'))
+assert col['ok'], col['violations']
+assert max(frames) < 1_000_000, 'full-model-scale frame on the adapter wire'
+"
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Byzantine leg (ROBUSTNESS.md §8 "Adversary model"): 2 honest peers + 1
 # adversarial peer that poisons (scaled payloads under re-announced
 # digests) and forges (announce one fingerprint, ship another) its

@@ -117,6 +117,7 @@ def build_cfg(args, wire: bool, chaos_seed: int, buffer: int = 0,
         num_clients=args.clients, num_rounds=args.rounds,
         seq_len=args.seq_len, batch_size=args.batch_size,
         max_local_batches=2, eval_every=0, seed=args.seed,
+        lora_rank=args.lora_rank,
         partition=PartitionConfig(kind="iid", iid_samples=8),
         ledger=LedgerConfig(enabled=True),
         faults=plan,
@@ -489,6 +490,9 @@ def main(argv=None) -> int:
                     help="global model versions the leader must produce "
                          "(also the wire leg's chaos-draw volume knob)")
     ap.add_argument("--model", default="tiny-bert")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="LoRA adapter exchange (COMPRESSION.md §7): > 0 "
+                         "puts adapter-scale payloads on the chaotic wire")
     ap.add_argument("--seq-len", type=int, default=16)
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--seed", type=int, default=42)

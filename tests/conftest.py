@@ -28,6 +28,15 @@ _XLA_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".xla_cache")
 jax.config.update("jax_compilation_cache_dir", _XLA_CACHE)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# ...and export it, so the dist loopback tests' PEER SUBPROCESSES (spawned
+# via dist.harness, which inherits os.environ) share the same persistent
+# cache. Without this every peer of every dist test recompiles its round
+# programs from scratch — the single largest avoidable cost in the tier-1
+# window. Peer cache keys differ from the pytest process's (peers build
+# 1-device meshes, no 8-device XLA flag) but are identical ACROSS dist
+# tests and re-runs, which is where the savings are.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _XLA_CACHE)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # the checkout under test must always win over any installed copy of the
 # package (a stale non-editable `pip install .` would otherwise shadow it)

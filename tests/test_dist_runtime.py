@@ -187,6 +187,7 @@ def test_capability_table_is_total_and_enforced():
     (dict(faults=FaultPlan(dropout_prob=0.5)), "dropout"),
     (dict(faults=FaultPlan(corrupt_prob=0.5)), "wire lane"),
     (dict(faults=FaultPlan(crash_at_round=1)), "crash"),
+    (dict(lora_ranks="2,4"), "uniform lora_rank"),
 ])
 def test_dist_rejections_come_from_the_table(kw, needle):
     with pytest.raises(ValueError, match="not supported on runtime='dist'"):
@@ -220,6 +221,11 @@ def test_dist_supported_combinations_construct():
                     dist=DistConfig(peers=3, buffer=3))
     assert cfg.aggregator == "trimmed_mean"
     assert cfg.reputation.enabled and cfg.faults.byz_enabled
+    # UNIFORM adapter exchange is a dist capability (the update/broadcast
+    # frames carry the adapter tree — tests/test_lora_exchange.py runs it);
+    # a uniform lora_ranks spec canonicalizes and constructs too
+    assert _dist_cfg(lora_rank=2).lora_rank == 2
+    assert _dist_cfg(lora_ranks="4,4").lora_rank == 4
     # ... but an ALL-adversarial federation is rejected: no honest
     # majority exists for any rule to defend
     with pytest.raises(ValueError, match="EVERY peer"):
@@ -277,6 +283,7 @@ def test_cfg_json_roundtrip_for_peer_processes():
 
     cfg = _dist_cfg(
         ledger=LedgerConfig(enabled=True),
+        lora_rank=2,  # peers must agree on the adapter wire payload
         compression=CompressionConfig(kind="topk", topk_frac=0.1),
         faults=FaultPlan(partition_groups=((0,), (1,)),
                          partition_rounds=(2, 3),

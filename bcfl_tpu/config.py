@@ -185,6 +185,12 @@ class DistConfig:
     # after this many frames (back-pressure) instead of buffering
     # model-sized trees without bound
     pipeline_depth: int = 2
+    # periodic host-resource sampling (metrics.ResourceMonitor sampling
+    # mode): every this-many seconds each peer emits a catalogued
+    # `resource` telemetry event (RSS, windowed CPU%) so the live
+    # monitor's health series can track drift across a long soak.
+    # 0.0 (default) = off; ignored when telemetry is off.
+    resource_sample_s: float = 0.0
 
     def __post_init__(self):
         if self.peers < 2:
@@ -222,6 +228,10 @@ class DistConfig:
         if self.pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {self.pipeline_depth}")
+        if self.resource_sample_s < 0:
+            raise ValueError(
+                f"resource_sample_s must be >= 0, got "
+                f"{self.resource_sample_s}")
 
 
 # --- runtime capability table (RUNTIME.md §2) --------------------------------

@@ -100,7 +100,8 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
              kill_peer: Optional[int] = None,
              kill_after_version: int = 1,
              restart_delay_s: float = 2.0,
-             restart_killed: bool = True) -> Dict:
+             restart_killed: bool = True,
+             churn: Optional[Dict] = None) -> Dict:
     """Run one full dist federation: spawn ``cfg.dist.peers`` peer
     processes, supervise them under a hard deadline, optionally SIGKILL
     ``kill_peer`` mid-run once its checkpoint has reached
@@ -113,6 +114,19 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
     the reachable quorum instead of stalling. The overall ``ok`` is False
     by construction there (the corpse's returncode and missing report);
     that leg's caller grades the survivors' reports instead.
+
+    ``churn`` drives REPEATED supervised kill/rejoin cycles of one peer —
+    the long-soak churn lane (scripts/dist_soak.py). RUNTIME_CAPS rejects
+    ``faults.churns`` on the dist runtime by design: peer-level churn IS
+    the crash/rejoin path, and this is it, exercised in a loop. A dict
+    ``{"peer", "cycles", "period_s", "downtime_s", "stop_after_s"}``:
+    every ``period_s`` seconds (measured from the peer's last restart),
+    while a checkpoint exists for it, the leader is still alive, and
+    fewer than ``cycles`` kills have fired (and, when ``stop_after_s`` is
+    set, only inside that window — the last rejoin must land well before
+    the leader finalizes, or the orphan re-joins a dead mesh), the peer
+    is SIGKILLed, left down ``downtime_s``, and restarted with
+    ``--resume``. Cycle records land under ``result["churn"]``.
 
     Returns ``{"ok", "returncodes", "reports", "run_dir", ...}``; raises
     nothing on peer failure — the caller inspects the result (and the logs
@@ -133,7 +147,10 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
     rcs: Dict[int, Optional[int]] = {p: None for p in range(n)}
     killed_restarted = False
     kill_record = None
+    churn_records: List[Dict] = []
     t0 = time.time()
+    churn_next = (t0 + float(churn.get("period_s", 45.0))
+                  if churn else None)
     while time.time() - t0 < deadline_s:
         for p, proc in list(procs.items()):
             rc = proc.poll()
@@ -164,6 +181,35 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
                 else:
                     rcs[kill_peer] = proc.returncode
                 killed_restarted = True
+        if (churn_next is not None and time.time() >= churn_next
+                and len(churn_records) < int(churn.get("cycles", 3))
+                and rcs.get(0) is None
+                and rcs.get(int(churn["peer"])) is None):
+            cp = int(churn["peer"])
+            stop_after = churn.get("stop_after_s")
+            if (stop_after is not None
+                    and time.time() - t0 > float(stop_after)):
+                churn_next = None   # window closed: no further cycles
+            else:
+                # checkpoint guard: only kill a peer that can resume
+                ckdir = os.path.join(run_dir, f"ckpt_peer{cp}")
+                if os.path.isdir(ckdir) and any(
+                        name.startswith("round_")
+                        for name in os.listdir(ckdir)):
+                    proc = procs[cp]
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait(timeout=30)
+                    _LIVE.discard(proc)
+                    getattr(proc, "_bcfl_log", None) \
+                        and proc._bcfl_log.close()
+                    time.sleep(float(churn.get("downtime_s", 2.0)))
+                    procs[cp] = spawn_peer(cfg_path, cp, ports, run_dir,
+                                           resume=True, platform=platform)
+                    churn_records.append(
+                        {"peer": cp, "cycle": len(churn_records) + 1,
+                         "killed_at_s": round(time.time() - t0, 3)})
+                    churn_next = (time.time()
+                                  + float(churn.get("period_s", 45.0)))
         if all(rc is not None for rc in rcs.values()):
             break
         time.sleep(0.25)
@@ -206,6 +252,7 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
         "reports": reports,
         "log_tails": logs,
         "kill": kill_record,
+        "churn": churn_records,
         "run_dir": run_dir,
         "event_streams": (find_streams(tele_dir)
                           if tele_dir is not None else []),

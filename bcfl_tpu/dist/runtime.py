@@ -610,6 +610,19 @@ class PeerRuntime:
                 robust_info is not None
                 and robust_info.get("k", 0) < self._robust_min))
         self.merges.append(rec)
+        # health-series extras (OBSERVABILITY.md §6): the leader's current
+        # per-peer trust vector and, when LoRA is on, the merged global
+        # adapter's effective rank (the rank-collapse guard statistic) —
+        # the live monitor folds both into health.jsonl per round
+        trust_map = ({str(p): round(float(self.rep.tracker.trust[p]), 6)
+                      for p in range(self.peers)}
+                     if self.rep is not None else None)
+        eff_rank = None
+        if self.eng._eff_rank is not None:
+            try:
+                eff_rank = float(self.eng._eff_rank(self.trainable))
+            except Exception:  # noqa: BLE001 — a health stat is never merge-fatal
+                pass
         # the FedBuff lineage event (OBSERVABILITY.md): which (peer,
         # msg_epoch, msg_id) updates, at what measured staleness and
         # merge weight, composed this model version — plus the chain
@@ -620,6 +633,7 @@ class PeerRuntime:
             degraded=rec.degraded, component=list(comp),
             quorum=rec.quorum, wall_s=rec.wall_s,
             robust=rec.robust, robust_degraded=rec.robust_degraded,
+            trust=trust_map, effective_rank=eff_rank,
             **({"chain_len": len(self.chain),
                 "head8": self.chain.head.hex()[:16], "rewrite": False}
                if self.chain is not None else {}))
@@ -1254,8 +1268,10 @@ class PeerRuntime:
                 # stream: the no_quarantined_merge invariant is
                 # pid-scoped, so without this a resumed leader's
                 # post-restart merges would be judged against an empty
-                # quarantine set (the prior evidence in the same
-                # append-mode stream keeps quarantine_evidence satisfied)
+                # quarantine set. quarantine_evidence exempts the
+                # from="restored" marker — a FOLLOWER restores verdicts
+                # it absorbed from the leader's broadcast chain rows and
+                # has no evidence events of its own to point at
                 telemetry.emit(
                     "rep.transition", client=int(p), scope="peer",
                     **{"from": "restored", "to": "quarantined",
@@ -1368,6 +1384,19 @@ class PeerRuntime:
                        epoch=self.transport.epoch,
                        pipeline=bool(self.cfg.dist.pipeline))
         self.transport.start()
+        # periodic host-resource sampling (cfg.dist.resource_sample_s):
+        # feeds the live monitor's health series. Only when this process
+        # has an event stream — the sampler emits through the same seam.
+        self._resmon = None
+        if (self.cfg.dist.resource_sample_s > 0
+                and self.events_path is not None):
+            try:
+                from bcfl_tpu.metrics.metrics import ResourceMonitor
+
+                self._resmon = ResourceMonitor()
+                self._resmon.start_sampling(self.cfg.dist.resource_sample_s)
+            except Exception as e:  # noqa: BLE001 — psutil absence never kills a peer
+                logger.warning("resource sampling unavailable: %s", e)
         if self.cfg.dist.pipeline:
             self._intake_thread = threading.Thread(
                 target=self._intake_loop, daemon=True,
@@ -1424,6 +1453,8 @@ class PeerRuntime:
             self.transport.flush_sends(timeout_s=2.0)
             self.transport.close()
             self._deadline_timer.cancel()
+            if self._resmon is not None:
+                self._resmon.stop_sampling()
         self._write_report(status="ok")
         return 0
 

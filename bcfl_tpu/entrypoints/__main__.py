@@ -6,6 +6,9 @@ config knob is an override flag.
 Subcommands: ``bcfl-tpu trace RUN_DIR`` collates a run's per-process event
 streams into one causally-ordered timeline and runs the invariant checks
 (bcfl_tpu.telemetry, OBSERVABILITY.md) — exit 1 on any violation.
+``bcfl-tpu monitor RUN_DIR`` is the LIVE counterpart: incremental
+collation + streaming invariants + the per-round health series over a run
+that is still going (OBSERVABILITY.md §6).
 ``bcfl-tpu lint [PATHS]`` runs the AST static-analysis checkers over the
 package (bcfl_tpu.analysis, ANALYSIS.md) — exit 1 on any unsuppressed
 finding; ``--list-checkers`` prints the catalogue.
@@ -31,6 +34,13 @@ def main(argv=None):
         from bcfl_tpu.telemetry import trace_main
 
         raise SystemExit(trace_main(argv[1:]))
+    if argv and argv[0] == "monitor":
+        # the LIVE observability subcommand (OBSERVABILITY.md §6): tails
+        # a possibly-running fleet's streams; no jax import, exits 1 on
+        # any invariant violation or unhealed critical alert
+        from bcfl_tpu.telemetry.live import monitor_main
+
+        raise SystemExit(monitor_main(argv[1:]))
     if argv and argv[0] == "lint":
         # the static-analysis subcommand (ANALYSIS.md): the checkers are
         # stdlib-ast only (the package import chain still pays the usual

@@ -72,6 +72,55 @@ class ResourceMonitor:
             "latency_min": (time.time() - self.t_before) / 60.0,
         }
 
+    # -------------------------------------------------- periodic sampling
+    # Before/after snapshots bound a run; a hundreds-of-rounds soak needs
+    # the drift BETWEEN them. The sampling thread emits one catalogued
+    # `resource` event per interval through the process telemetry seam
+    # (absolute RSS, not the delta — the health series plots a level, and
+    # windowed CPU% per psutil's interval semantics above), so the live
+    # monitor's health.jsonl can track host memory/CPU across the soak.
+    # A daemon thread with a waitable stop event: never blocks exit, and
+    # the emit seam is a no-op when telemetry is off.
+
+    def start_sampling(self, interval_s: float) -> bool:
+        """Begin emitting `resource` telemetry events every ``interval_s``
+        seconds (idempotent; returns False when already running or the
+        interval is non-positive)."""
+        import threading
+
+        if interval_s <= 0 or getattr(self, "_sample_thread", None):
+            return False
+        from bcfl_tpu.telemetry import events as _telemetry
+
+        self._sample_stop = threading.Event()
+
+        def _loop():
+            # a dedicated windowed-CPU baseline for the sampler: sharing
+            # snapshot()'s window would make both readings meaningless
+            while not self._sample_stop.wait(interval_s):
+                try:
+                    _telemetry.emit(
+                        "resource",
+                        rss_gb=self._proc.memory_info().rss / 1e9,
+                        cpu_percent=self._proc.cpu_percent(None),
+                        interval_s=interval_s)
+                except Exception:  # noqa: BLE001 — observer never crashes the run
+                    pass
+
+        self._sample_thread = threading.Thread(
+            target=_loop, daemon=True, name="bcfl-resource-sampler")
+        self._sample_thread.start()
+        return True
+
+    def stop_sampling(self) -> None:
+        """Stop the sampling thread (idempotent, joins briefly)."""
+        t = getattr(self, "_sample_thread", None)
+        if t is None:
+            return
+        self._sample_stop.set()
+        t.join(timeout=5.0)
+        self._sample_thread = None
+
 
 @dataclasses.dataclass
 class RoundRecord:

@@ -14,6 +14,10 @@ causal cross-peer collator, and invariant checks as queries
   (no double-merge, acked-never-lost, no cross-partition merge,
   quarantine-with-evidence, monotone ledger heads) run as queries over the
   merged stream.
+- :mod:`bcfl_tpu.telemetry.live` — the live counterpart: incremental
+  stream tailing, streaming invariant checks with batch parity, the
+  per-round ``health.jsonl`` series + threshold alerts, and the
+  ``bcfl-tpu monitor`` CLI (OBSERVABILITY.md §6).
 """
 
 from bcfl_tpu.telemetry.collate import (  # noqa: F401
@@ -40,4 +44,14 @@ from bcfl_tpu.telemetry.events import (  # noqa: F401
 from bcfl_tpu.telemetry.invariants import (  # noqa: F401
     INVARIANTS,
     run_invariants,
+)
+from bcfl_tpu.telemetry.live import (  # noqa: F401
+    AlertManager,
+    AlertThresholds,
+    HealthRollup,
+    LiveCollator,
+    STREAMING_CHECKS,
+    StreamingInvariantSuite,
+    StreamTailer,
+    monitor_main,
 )

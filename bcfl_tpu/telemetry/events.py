@@ -95,6 +95,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     # counter sat below a sender's base version; the merge clamps the
     # decay exponent to 0 and records the raw value here
     "warn": ("what",),
+    # --- live monitoring (bcfl_tpu.telemetry.live) ---
+    # per-round health rollup written by the monitor process into its OWN
+    # stream (health.jsonl — deliberately outside the events_*.jsonl glob
+    # so the collator never ingests the observer's observations)
+    "health": ("round",),
+    # threshold alert lifecycle: severity info|warn|critical; the same
+    # (what, key) fires once and heals once (healed=true). Only unhealed
+    # CRITICAL alerts gate the monitor's exit code.
+    "alert": ("what", "severity"),
+    # periodic host-resource sample (metrics.ResourceMonitor sampling
+    # mode) — lets the health series track memory/CPU drift across a soak
+    "resource": ("rss_gb", "cpu_percent"),
 }
 
 

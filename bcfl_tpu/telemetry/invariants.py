@@ -29,7 +29,11 @@ rule consumes):
 - **quarantine_evidence** — the reputation lifecycle quarantines only on
   observed evidence; a ``rep.transition`` to ``quarantined`` with no prior
   ``rep.evidence`` for that client in the same stream is a state machine
-  acting on nothing.
+  acting on nothing. ``from: "restored"`` re-declarations are exempt:
+  they replay state whose evidence lives at the original decision site
+  (a resumed leader's earlier incarnation, or — for a follower that
+  absorbed the leader's committed verdicts from broadcast chain rows —
+  a different process entirely).
 - **monotone_heads** — a peer's ledger chain only ever grows, except at a
   declared rewrite (fork-merge adoption / full resync), which the emitting
   site flags ``rewrite: true``. A length decrease on a non-rewrite event
@@ -176,6 +180,15 @@ def quarantine_evidence(events: List[Dict]) -> List[Dict]:
         if ev == "rep.evidence":
             evidenced.add((_peer_of(e), e.get("client")))
         elif ev == "rep.transition" and e.get("to") == "quarantined":
+            if e.get("from") == "restored":
+                # a re-declaration of restored state, not a fresh
+                # decision: a resumed process replays quarantines whose
+                # evidence lives elsewhere — the leader's own stream, or
+                # (for a follower that absorbed the leader's committed
+                # verdicts from broadcast chain rows) another process
+                # entirely. The decision site was evidenced; this event
+                # only re-anchors it for pid-scoped checks.
+                continue
             key = (_peer_of(e), e.get("client"))
             if key not in evidenced:
                 out.append({

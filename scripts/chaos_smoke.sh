@@ -320,4 +320,121 @@ print('collator: %d events, %d merges (%d arrivals, %d unique ids), '
     (t['message_latency_s'] or {}).get('p95', float('nan')),
     'CLEAN' if d['ok'] else 'VIOLATED'))
 "
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Monitor leg (OBSERVABILITY.md §6): `bcfl-tpu monitor` attached LIVE to a
+# 2-peer wire-chaos run — streaming invariant checks + per-round
+# health.jsonl while the peers are still writing. Gates: the live monitor
+# exits 0 AND its final per-rule verdict equals the post-hoc batch trace
+# on the same streams (verdict parity on a real concurrent run, not just
+# on the seeded fixtures tier-1 covers). Then the inverse proof: a
+# seeded-violation stream that has NOT closed (no run.end — the "run"
+# is still alive) must make the monitor exit 1, so a silently-green
+# monitor can never pass this leg. The long-horizon composition (wire +
+# byzantine + churn, hundreds of versions, monitor gating live) is
+# scripts/dist_soak.py -> results/dist_soak.json.
+echo
+echo "monitor leg: live bcfl-tpu monitor over a 2-peer wire-chaos run"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
+                             PartitionConfig)
+from bcfl_tpu.dist.harness import run_dist
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.telemetry import collate
+
+run_dir = "/tmp/bcfl_chaos_monitor_run"
+if os.path.isdir(run_dir):
+    shutil.rmtree(run_dir)
+os.makedirs(run_dir)
+stop = os.path.join(run_dir, "monitor.stop")
+summary_path = "/tmp/bcfl_chaos_monitor_summary.json"
+mon = subprocess.Popen(
+    [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor", run_dir,
+     "--quiet", "--poll", "0.5", "--stop-file", stop,
+     "--summary-out", summary_path, "--max-wall", "500", "--idle", "400",
+     "--stall-critical-s", "600"])
+cfg = FedConfig(
+    name="monitor_smoke", runtime="dist", mode="server", sync="async",
+    model="tiny-bert", dataset="synthetic", num_clients=4, num_rounds=4,
+    seq_len=16, batch_size=4, max_local_batches=2, eval_every=0, seed=42,
+    partition=PartitionConfig(kind="iid", iid_samples=8),
+    ledger=LedgerConfig(enabled=True),
+    faults=FaultPlan(seed=7, wire_drop_prob=0.2, wire_dup_prob=0.2,
+                     wire_reorder_prob=0.2, wire_reorder_hold_s=0.2),
+    dist=DistConfig(peers=2, buffer_timeout_s=10.0, idle_timeout_s=90.0,
+                    peer_deadline_s=300.0, checkpoint_every_versions=1,
+                    suspect_after=1))
+try:
+    result = run_dist(cfg, run_dir, deadline_s=400.0, platform="cpu")
+finally:
+    with open(stop, "w") as f:
+        f.write("done\n")
+mon_rc = mon.wait(timeout=120)
+assert result["ok"], (result["returncodes"], result["log_tails"])
+assert mon_rc == 0, f"live monitor exited {mon_rc} on a clean chaos run"
+with open(summary_path) as f:
+    mon_summary = json.load(f)
+col = collate(result["event_streams"])
+col.pop("ordered")
+assert col["ok"], col["violations"]
+assert mon_summary["invariants"] == col["invariants"], (
+    "monitor-vs-trace verdict drift", mon_summary["invariants"],
+    col["invariants"])
+assert mon_summary["health_records"] > 0, "no health series from a live run"
+assert os.path.exists(os.path.join(run_dir, "health.jsonl"))
+print("monitor leg: live verdict == batch trace "
+      f"({mon_summary['events']} events, "
+      f"{mon_summary['health_records']} health records, "
+      f"{mon_summary['alerts']['fired']} alerts fired) -- CLEAN both ways")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+timeout -k 10 120 python - <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+# the inverse proof: a double-merge in a stream with NO run.end (the run
+# is "still alive") — the monitor must flag it NOW, not at close
+run_dir = "/tmp/bcfl_chaos_monitor_seeded"
+if os.path.isdir(run_dir):
+    shutil.rmtree(run_dir)
+os.makedirs(run_dir)
+arr = {"peer": "A", "msg_id": 0, "epoch": 1, "staleness": 0, "weight": 1.0}
+events = [
+    {"ev": "send", "peer": "A", "pid": 11, "seq": 0, "t_wall": 10.0,
+     "to": "B", "msg_id": 0, "epoch": 1, "ok": True, "type": "update",
+     "attempts": 1, "wall_s": 0.01},
+    {"ev": "recv", "peer": "B", "pid": 12, "seq": 0, "t_wall": 10.2,
+     "src": "A", "msg_id": 0, "epoch": 1, "disposition": "accepted"},
+    {"ev": "merge", "peer": "B", "pid": 12, "seq": 1, "t_wall": 11.0,
+     "version": 1, "arrivals": [arr], "component": ["A", "B"]},
+    {"ev": "merge", "peer": "B", "pid": 12, "seq": 2, "t_wall": 12.0,
+     "version": 2, "arrivals": [arr], "component": ["A", "B"]},
+]
+for peer in ("A", "B"):
+    with open(os.path.join(run_dir, f"events_peer{peer}.jsonl"),
+              "w") as f:
+        for e in events:
+            if e["peer"] == peer:
+                f.write(json.dumps(e) + "\n")
+rc = subprocess.call(
+    [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor", run_dir,
+     "--once", "--quiet", "--health-out", "off"])
+assert rc == 1, (f"monitor exit {rc} on a seeded double-merge in an "
+                 "OPEN stream (expected 1 -- the checkers are inert)")
+print("monitor leg: seeded mid-run violation detected (exit 1) -- "
+      "the live gate is armed")
+EOF
 exit $?

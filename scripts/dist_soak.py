@@ -1,0 +1,389 @@
+"""Long-horizon soak: hundreds of versions with EVERY chaos lane armed at
+once, gated LIVE by the monitor (OBSERVABILITY.md §6, RUNTIME.md §7-§8).
+
+The chaos/byzantine proofs (scripts/dist_chaos.py, dist_byzantine.py) run
+each adversity for ~10 versions and grade post-hoc. This driver is the
+long-horizon composition none of them exercises:
+
+- **wire** — drop/dup/reorder/delay/corrupt active at the socket boundary
+  for the entire run,
+- **byzantine** — the highest peer poisons and forges every update it
+  sends, under trimmed_mean + wire-evidence reputation,
+- **churn** — one follower is REPEATEDLY SIGKILLed and restarted with
+  ``--resume`` (the harness churn lane; peer-level churn is the dist
+  crash/rejoin path, exercised in a loop),
+- **resource sampling** — every peer emits periodic catalogued
+  ``resource`` events (``DistConfig.resource_sample_s``),
+
+while ``bcfl-tpu monitor`` is attached CONCURRENTLY in ``--fail-fast``
+mode: a watcher thread reaps the whole fleet the moment the monitor exits
+nonzero mid-run, so a violated invariant stops a multi-hour soak at the
+violation, not at the end. The monitor also writes the per-round
+``health.jsonl`` series (round wall, bytes on wire, staleness p50/p95,
+merge-weight spread, quorum state, per-peer trust) the soak gates on.
+
+Gates (all hard, recorded in ``results/dist_soak.json``):
+
+- the fleet completes with every peer rc=0 and status ok, and the leader
+  reached ``--rounds`` (>= 100) versions;
+- the live monitor exited 0: ZERO invariant violations and zero unhealed
+  critical alerts across the whole horizon;
+- **monitor-vs-trace parity** — the live monitor's final per-rule verdict
+  equals the post-hoc batch ``bcfl-tpu trace`` verdict on the same
+  streams (the streaming checkers and the batch suite must agree on a
+  real run, not just on seeded fixtures);
+- ``health.jsonl`` exists, parses clean, and its per-round series covers
+  the target horizon;
+- the churn lane actually cycled (>= ``--churn-cycles`` kill/rejoin
+  records) and the byzantine lane actually injected;
+- the leader's tracker distrusts the adversary;
+- catalogued ``resource`` samples landed in the peers' own streams;
+- every surviving chain replica verifies.
+
+Usage: python scripts/dist_soak.py [--rounds 120] [--peers 3]
+           [--deadline 2700] [--platform cpu] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def build_cfg(args):
+    from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
+                                 PartitionConfig)
+    from bcfl_tpu.faults import FaultPlan
+    from bcfl_tpu.reputation import ReputationConfig
+
+    plan = FaultPlan(
+        seed=args.chaos_seed,
+        wire_drop_prob=args.wire_drop, wire_dup_prob=args.wire_dup,
+        wire_reorder_prob=args.wire_reorder, wire_reorder_hold_s=0.2,
+        wire_delay_prob=args.wire_delay, wire_delay_s=0.05,
+        wire_corrupt_prob=args.wire_corrupt,
+        # the adversary lies for the WHOLE horizon, not a burst
+        byz_peers=(args.peers - 1,), byz_prob=1.0,
+        byz_behaviors=("scale", "digest_forge"))
+    return FedConfig(
+        name="dist_soak", runtime="dist", mode="server", sync="async",
+        model=args.model, dataset="synthetic",
+        num_clients=args.clients, num_rounds=args.rounds,
+        seq_len=args.seq_len, batch_size=args.batch_size,
+        max_local_batches=1, eval_every=0, seed=args.seed,
+        lora_rank=args.lora_rank,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        # the armed defense: robust buffered merge + per-peer
+        # wire-evidence reputation (same arming as the byzantine legs)
+        aggregator="trimmed_mean",
+        reputation=ReputationConfig(enabled=True,
+                                    quarantine_rounds=100_000),
+        faults=plan,
+        dist=DistConfig(
+            peers=args.peers, buffer=args.peers,
+            buffer_timeout_s=args.buffer_timeout,
+            idle_timeout_s=args.idle_timeout,
+            peer_deadline_s=args.deadline,
+            checkpoint_every_versions=5,
+            suspect_after=1,
+            # satellite: periodic catalogued `resource` events from every
+            # peer, rolled into the monitor's health series
+            resource_sample_s=args.resource_sample_s),
+        checkpoint_dir=None,
+    )
+
+
+def attach_monitor(run_dir: str, stop_path: str, summary_path: str,
+                   args) -> subprocess.Popen:
+    """Spawn ``bcfl-tpu monitor`` against the (initially empty) run dir.
+
+    The monitor process never imports jax — attaching it BEFORE the fleet
+    spawns is cheap, and it discovers each peer's stream the sweep after
+    the stream's first flush. Stall thresholds are widened to sit above
+    cold-compile time; trust collapse of the adversary is an EXPECTED
+    warn, never a gate."""
+    log = open(os.path.join(run_dir, "monitor.log"), "ab")
+    cmd = [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor",
+           run_dir,
+           "--fail-fast",
+           "--poll", "0.5",
+           "--stop-file", stop_path,
+           "--summary-out", summary_path,
+           "--max-wall", str(args.deadline + 300.0),
+           "--idle", str(args.deadline + 300.0),
+           "--stall-warn-s", "240",
+           "--stall-critical-s", "900"]
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            cwd=REPO_ROOT)
+    proc._soak_log = log
+    return proc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=None,
+                    help="default: 2 per peer")
+    ap.add_argument("--rounds", type=int, default=120,
+                    help="global versions the leader must reach "
+                         "(the soak horizon; acceptance floor is 100)")
+    ap.add_argument("--model", default="tiny-bert")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="> 0 soaks adapter-scale payloads and puts "
+                         "effective_rank on the health series")
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--chaos-seed", type=int, default=11)
+    ap.add_argument("--wire-drop", type=float, default=0.1)
+    ap.add_argument("--wire-dup", type=float, default=0.1)
+    ap.add_argument("--wire-reorder", type=float, default=0.1)
+    ap.add_argument("--wire-delay", type=float, default=0.1)
+    ap.add_argument("--wire-corrupt", type=float, default=0.02)
+    ap.add_argument("--churn-cycles", type=int, default=3)
+    ap.add_argument("--churn-period", type=float, default=45.0,
+                    help="seconds between kill/rejoin cycles of peer 1")
+    ap.add_argument("--churn-downtime", type=float, default=2.0)
+    ap.add_argument("--resource-sample-s", type=float, default=2.0)
+    ap.add_argument("--buffer-timeout", type=float, default=10.0)
+    ap.add_argument("--idle-timeout", type=float, default=180.0)
+    ap.add_argument("--deadline", type=float, default=2700.0)
+    ap.add_argument("--platform", default=os.environ.get("JAX_PLATFORMS")
+                    or "cpu")
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink the horizon for a smoke pass (NOT the "
+                         "acceptance artifact): 12 versions, 1 churn "
+                         "cycle, short period")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT, "results",
+                                                  "dist_soak.json"))
+    args = ap.parse_args(argv)
+    if args.clients is None:
+        args.clients = 2 * args.peers
+    if args.quick:
+        args.rounds = min(args.rounds, 12)
+        args.churn_cycles = 1
+        args.churn_period = 20.0
+        args.deadline = min(args.deadline, 900.0)
+    if args.peers < 3:
+        print("dist_soak needs >= 3 peers (trimmed_mean around one "
+              "adversary + a churning follower)", file=sys.stderr)
+        return 2
+
+    from bcfl_tpu.dist import harness
+    from bcfl_tpu.telemetry import collate
+
+    cfg = build_cfg(args)
+    run_dir = os.path.join("/tmp", f"bcfl_dist_soak_{os.getpid()}")
+    if os.path.isdir(run_dir):
+        shutil.rmtree(run_dir)
+    os.makedirs(run_dir, exist_ok=True)
+    stop_path = os.path.join(run_dir, "monitor.stop")
+    summary_path = os.path.join(run_dir, "monitor_summary.json")
+
+    adversary = args.peers - 1
+    churn_peer = 1  # a follower that is neither leader nor adversary
+    # the last rejoin must land while the mesh is alive: close the churn
+    # window well before the horizon plausibly completes
+    churn = {"peer": churn_peer, "cycles": args.churn_cycles,
+             "period_s": args.churn_period,
+             "downtime_s": args.churn_downtime,
+             "stop_after_s": args.deadline * 0.5}
+
+    print(f"dist_soak: {args.peers} peers x "
+          f"{args.clients // args.peers} clients, target {args.rounds} "
+          f"versions; wire+byzantine+churn armed, monitor attached live "
+          f"-> {run_dir}", flush=True)
+    t0 = time.time()
+    mon = attach_monitor(run_dir, stop_path, summary_path, args)
+
+    # the live gate: the moment the monitor exits nonzero mid-run (first
+    # violation in --fail-fast, or an unhealed critical), reap the fleet
+    run_done = threading.Event()
+    monitor_aborted = {}
+
+    def _watch():
+        while mon.poll() is None:
+            if run_done.wait(1.0):
+                return
+        if mon.returncode != 0 and not run_done.is_set():
+            monitor_aborted["rc"] = mon.returncode
+            print(f"dist_soak: monitor exited rc={mon.returncode} "
+                  "MID-RUN -- reaping the fleet", flush=True)
+            harness.reap_all()
+
+    watcher = threading.Thread(target=_watch, daemon=True,
+                               name="soak-monitor-watch")
+    watcher.start()
+    try:
+        result = harness.run_dist(cfg, run_dir, deadline_s=args.deadline,
+                                  platform=args.platform, churn=churn)
+    finally:
+        run_done.set()
+    # fleet done: tell the monitor to finalize (all_closed usually beats
+    # the stop file; the file covers SIGKILLed never-closed streams)
+    with open(stop_path, "w") as f:
+        f.write("fleet done\n")
+    try:
+        mon_rc = mon.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        mon.kill()
+        mon_rc = -9
+    getattr(mon, "_soak_log", None) and mon._soak_log.close()
+    watcher.join(timeout=5)
+
+    mon_summary = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            mon_summary = json.load(f)
+
+    # post-hoc batch verdict on the SAME streams: the parity gate
+    col = collate(result["event_streams"])
+    col.pop("ordered")
+    mon_inv = mon_summary.get("invariants") or {}
+    batch_inv = col["invariants"]  # per-rule violation counts
+    parity = mon_inv == batch_inv
+
+    # health.jsonl: present, clean, per-round series covering the horizon
+    health_path = os.path.join(run_dir, "health.jsonl")
+    health_rounds, health_bad = [], True
+    health_keys_ok = False
+    if os.path.exists(health_path):
+        from bcfl_tpu.telemetry import read_stream
+
+        hev, hmeta = read_stream(health_path)
+        health = [e for e in hev if e["ev"] == "health"]
+        health_rounds = sorted({e["round"] for e in health})
+        health_bad = bool(hmeta["corrupt_lines"] or hmeta["torn_tail"])
+        need = {"round", "wall_s", "bytes_wire", "staleness_p50",
+                "staleness_p95", "weight_mean", "arrivals", "trust"}
+        health_keys_ok = bool(health) and all(
+            need <= set(e) for e in health)
+
+    reports = result["reports"]
+    leader = reports.get(0, {})
+    leader_rep = leader.get("reputation") or {}
+    adv_state = (leader_rep.get("state")
+                 or [None] * args.peers)[adversary]
+    adv_trust = (leader_rep.get("trust") or [1.0] * args.peers)[adversary]
+    byz_total = (reports.get(adversary, {}).get("byzantine")
+                 or {}).get("total", 0)
+    # catalogued resource samples ride each peer's own stream
+    from bcfl_tpu.telemetry import read_stream
+
+    resource_samples = 0
+    for path in result["event_streams"]:
+        evs, _ = read_stream(path)
+        resource_samples += sum(1 for e in evs if e["ev"] == "resource")
+
+    gates = {
+        "fleet_completed": (result["ok"]
+                            and len(reports) == args.peers),
+        "target_versions_reached": (
+            (leader.get("final_version") or 0) >= args.rounds),
+        "monitor_exit_zero": mon_rc == 0,
+        "monitor_never_aborted_fleet": not monitor_aborted,
+        "zero_invariant_violations_live": (
+            mon_summary.get("invariant_violations_total") == 0),
+        "zero_invariant_violations_batch": col["ok"],
+        "monitor_trace_parity": parity,
+        "no_unhealed_critical_alerts": (
+            not (mon_summary.get("alerts") or {})
+            .get("unhealed_critical", ["missing"])),
+        "health_series_present": (not health_bad) and health_keys_ok,
+        "health_series_covers_horizon": (
+            bool(health_rounds) and health_rounds[-1] >= args.rounds),
+        "churn_cycles_completed": (
+            len(result.get("churn") or []) >= args.churn_cycles),
+        "byz_injections_nonzero": byz_total > 0,
+        "adversary_distrusted": (
+            adv_state == "quarantined"
+            or (adv_trust is not None and adv_trust < 0.7)),
+        "resource_samples_recorded": resource_samples > 0,
+        "chains_verify": bool(reports) and all(
+            rep.get("chain_ok") in (True, None)
+            for rep in reports.values()),
+    }
+    record = {
+        "proof": "dist_soak", "peers": args.peers,
+        "clients": args.clients, "target_versions": args.rounds,
+        "quick": args.quick,
+        "lanes": {
+            "wire": {"drop": args.wire_drop, "dup": args.wire_dup,
+                     "reorder": args.wire_reorder,
+                     "delay": args.wire_delay,
+                     "corrupt": args.wire_corrupt},
+            "byzantine": {"peer": adversary, "injections": byz_total,
+                          "state_at_leader": adv_state,
+                          "trust_at_leader": adv_trust},
+            "churn": {"peer": churn_peer,
+                      "cycles": result.get("churn")},
+            "resource_sample_s": args.resource_sample_s,
+        },
+        "monitor": {
+            "rc": mon_rc,
+            "summary": mon_summary,
+            "aborted_fleet": monitor_aborted or None,
+        },
+        "batch_trace": {
+            "ok": col["ok"],
+            "invariants": batch_inv,
+            "violations": col["violations"],
+            "torn_tails": col["torn_tails"],
+            "timeline": {
+                "events": col["timeline"]["events"],
+                "merges": col["timeline"]["merges"],
+                "message_latency_s": col["timeline"]
+                ["message_latency_s"],
+                "staleness": col["timeline"]["staleness"],
+            },
+        },
+        "parity": {"monitor": mon_inv, "batch": batch_inv,
+                   "equal": parity},
+        "health": {"path": health_path,
+                   "records": len(health_rounds),
+                   "first_round": (health_rounds[0]
+                                   if health_rounds else None),
+                   "last_round": (health_rounds[-1]
+                                  if health_rounds else None)},
+        "resource_samples": resource_samples,
+        "final_versions": {p: r.get("final_version")
+                           for p, r in reports.items()},
+        "returncodes": result["returncodes"],
+        "run_dir": run_dir,
+        "wall_s": time.time() - t0,
+        "recorded_at": int(time.time()),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    if not record["ok"]:
+        record["log_tails"] = result["log_tails"]
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps({"gates": gates, "wall_s": record["wall_s"],
+                      "final_versions": record["final_versions"],
+                      "health_records": record["health"]["records"]},
+                     indent=2), flush=True)
+    if not record["ok"]:
+        for p, tail in (result["log_tails"] or {}).items():
+            print(f"--- peer {p} log tail ---\n{tail}", flush=True)
+        print(f"dist_soak FAILED (evidence in {args.out})", flush=True)
+        return 1
+    print(f"dist_soak OK in {record['wall_s']:.1f}s -> {args.out}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,567 @@
+"""Live monitoring layer (bcfl_tpu.telemetry.live, OBSERVABILITY.md §6) —
+tier-1.
+
+Three contracts, each load-bearing for the long-horizon soak
+(scripts/dist_soak.py) that gates on the monitor live:
+
+1. **Tailer parity** — an incremental tailer fed ANY chunking of a
+   stream's bytes (one byte at a time, mid-line, mid-frame) yields the
+   same events and the same finalize meta as the batch
+   :func:`read_stream`, including the subtle torn-tail classifications.
+2. **Streaming-vs-batch invariant parity** — on every seeded fixture from
+   tests/test_telemetry.py (clean + each firing corruption), the
+   streaming checkers' final verdicts equal
+   ``run_invariants(causal_order(events))`` exactly, regardless of chunk
+   boundaries or cross-stream interleave. A live monitor that disagrees
+   with the post-hoc trace would make the soak's verdict meaningless.
+3. **Health + alert lifecycle** — one health record per merge with the
+   declared rollup fields; alerts fire once, heal once, and only
+   violations / unhealed CRITICAL alerts gate the monitor's exit code
+   (an expected byzantine trust collapse is a warn, not a failure).
+"""
+
+import json
+import os
+
+import pytest
+
+import test_telemetry as tt
+from bcfl_tpu import telemetry as T
+from bcfl_tpu.telemetry.invariants import INVARIANTS, run_invariants
+from bcfl_tpu.telemetry.live import (
+    CRITICAL,
+    STREAMING_CHECKS,
+    WARN,
+    AlertManager,
+    AlertThresholds,
+    HealthRollup,
+    LiveCollator,
+    StreamingInvariantSuite,
+    StreamTailer,
+    evaluate_health_alerts,
+    monitor_main,
+)
+
+pytestmark = pytest.mark.telemetry
+
+_ev, _send, _recv = tt._ev, tt._send, tt._recv
+_merge, _end, _arrival = tt._merge, tt._end, tt._arrival
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _quarantined_merge_run():
+    """A leader that merges an arrival from a peer its own tracker holds
+    QUARANTINED at merge time (scope='peer' — the dist lane)."""
+    return tt._clean_run() + [
+        _ev("rep.evidence", "B", 5, 12.5, client="A", fault=1.0),
+        _ev("rep.transition", "B", 6, 12.6, client="A", trust=0.05,
+            scope="peer", **{"from": "suspect", "to": "quarantined"}),
+        _merge("B", 7, 13.0, version=3, arrivals=[_arrival("A", 2)],
+               component=["A", "B"], chain_len=6, head8="cc",
+               rewrite=False),
+        _send("A", 2, 12.8, to="B", msg_id=2),
+        _recv("B", 8, 12.9, src="A", msg_id=2),
+    ]
+
+
+def _fixtures():
+    """(name, events, firing_rules) — every seeded corruption from
+    tests/test_telemetry.py plus the quarantined-merge lane, and the
+    legal twins that must stay silent."""
+    out = []
+    out.append(("clean", tt._clean_run(), set()))
+
+    ev = tt._clean_run()
+    ev[3]["arrivals"] = [_arrival("A", 0)]
+    out.append(("double_merge", ev, {"no_double_merge"}))
+
+    ev = tt._clean_run()
+    ev[3]["arrivals"] = [{"peer": "A", "staleness": 0}]
+    out.append(("identityless_arrival", ev, {"no_double_merge"}))
+
+    remerge = _merge("B", 0, 30.0, version=1, arrivals=[_arrival("A", 0)],
+                     component=["A", "B"], chain_len=2, head8="aa",
+                     rewrite=False)
+    remerge["pid"] = 99999
+    out.append(("fresh_incarnation_remerge", tt._clean_run() + [remerge],
+                set()))
+
+    ev = tt._clean_run()
+    del ev[5]  # B never saw msg 1, yet A recorded it acked
+    out.append(("lost_acked", ev, {"acked_not_lost"}))
+
+    ev2 = [e for e in ev if not (e["ev"] == "run.end"
+                                 and e["peer"] == "B")]
+    out.append(("lost_acked_no_close", ev2, set()))
+
+    ev3 = [dict(e) for e in ev]
+    for e in ev3:
+        if e["peer"] == "B" and e["seq"] >= 3:
+            e["pid"] = 4242
+    out.append(("lost_acked_two_pids", ev3, set()))
+
+    ev4 = [dict(e) for e in ev]
+    for e in ev4:
+        if e["ev"] == "send" and e.get("msg_id") == 1:
+            e["wall_s"] = 30.0
+    out.append(("lost_acked_past_grace", ev4, set()))
+
+    ev = tt._clean_run()
+    ev[2]["component"] = ["B", "C"]
+    out.append(("cross_partition", ev, {"no_cross_partition_merge"}))
+
+    trans = _ev("rep.transition", "B", 5, 13.0, client=2, trust=0.1,
+                **{"from": "suspect", "to": "quarantined"})
+    out.append(("quarantine_no_evidence", tt._clean_run() + [trans],
+                {"quarantine_evidence"}))
+    evid = _ev("rep.evidence", "B", 4, 12.5, client=2, fault=1.0)
+    out.append(("quarantine_with_evidence",
+                tt._clean_run() + [evid, dict(trans, seq=6)], set()))
+    # a resumed follower's from="restored" re-declaration carries no
+    # local evidence by design (absorbed from the leader's chain rows)
+    restored = _ev("rep.transition", "B", 5, 13.0, client=2, trust=0.3,
+                   scope="peer",
+                   **{"from": "restored", "to": "quarantined"})
+    out.append(("quarantine_restored_exempt", tt._clean_run() + [restored],
+                set()))
+
+    shrink = _ev("ledger", "B", 5, 14.0, op="append", chain_len=1,
+                 rewrite=False, head8="cc")
+    out.append(("shrinking_chain", tt._clean_run() + [shrink],
+                {"monotone_heads"}))
+    out.append(("shrink_rewrite_exempt",
+                tt._clean_run() + [dict(shrink, op="resync",
+                                        rewrite=True)], set()))
+    fresh = dict(_ev("ledger", "B", 0, 30.0, op="commit", chain_len=1,
+                     rewrite=False, head8="dd"), pid=99999)
+    out.append(("shrink_fresh_pid_exempt", tt._clean_run() + [fresh],
+                set()))
+
+    out.append(("quarantined_merge", _quarantined_merge_run(),
+                {"no_quarantined_merge"}))
+    return out
+
+
+def _streams_of(events):
+    """Split a fixture into per-peer stream byte blobs, preserving the
+    fixture's list order within each peer (= physical file order)."""
+    by_peer = {}
+    for e in events:
+        by_peer.setdefault(str(e["peer"]), []).append(e)
+    return {p: b"".join(json.dumps(e).encode() + b"\n" for e in evs)
+            for p, evs in by_peer.items()}
+
+
+def _stream_verdict(events, chunk):
+    """Feed the fixture through tailers + the streaming suite with a
+    round-robin cross-stream interleave at the given chunk size."""
+    streams = _streams_of(events)
+    tailers = {p: StreamTailer(p) for p in streams}
+    suite = StreamingInvariantSuite()
+    offs = dict.fromkeys(streams, 0)
+    progressed = True
+    while progressed:
+        progressed = False
+        for p, data in streams.items():
+            o = offs[p]
+            if o >= len(data):
+                continue
+            progressed = True
+            piece = data[o:o + chunk]
+            offs[p] = o + len(piece)
+            for e in tailers[p].feed_bytes(piece):
+                suite.feed(e)
+    for p in streams:
+        tail_e, _meta = tailers[p].finalize()
+        if tail_e is not None:
+            suite.feed(tail_e)
+    return suite.finalize()
+
+
+def _norm(verdict):
+    return {k: sorted(json.dumps(v, sort_keys=True) for v in vs)
+            for k, vs in verdict.items()}
+
+
+# ------------------------------------------------------------ tailer parity
+
+
+def _tailer_replay(data, chunk):
+    t = StreamTailer("x")
+    evs = []
+    for i in range(0, len(data), chunk):
+        evs.extend(t.feed_bytes(data[i:i + chunk]))
+    tail_e, meta = t.finalize()
+    if tail_e is not None:
+        evs.append(tail_e)
+    return evs, meta
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 17, 1 << 20])
+def test_tailer_matches_read_stream_on_damaged_bytes(tmp_path, chunk):
+    """Every torn/corrupt classification read_stream makes, the tailer
+    must make identically — including newline-terminated garbage at EOF
+    (torn, not corrupt) and a parseable unterminated final line (an
+    event)."""
+    cases = {
+        # clean close
+        "clean": b'{"ev":"round","peer":0,"seq":0,"round":0,"wall_s":1}\n',
+        # torn final line (SIGKILL mid-write)
+        "torn": b'{"ev":"round","peer":0,"seq":0,"round":0,"wall_s":1}\n'
+                b'{"ev":"round","pee',
+        # corrupt middle + clean end
+        "corrupt_mid": b'{"ev":"round","peer":0,"seq":0}\nGARBAGE{{{\n'
+                       b'{"ev":"round","peer":0,"seq":1}\n',
+        # newline-terminated garbage at EOF: read_stream calls it TORN
+        "torn_terminated": b'{"ev":"round","peer":0,"seq":0}\nGARB{{\n',
+        # a final line with no newline that PARSES is a valid event
+        "parseable_tail": b'{"ev":"round","peer":0,"seq":0}\n'
+                          b'{"ev":"round","peer":0,"seq":1}',
+        # whitespace-only tail is ignored, not torn
+        "ws_tail": b'{"ev":"round","peer":0,"seq":0}\n   ',
+        # empty stream
+        "empty": b"",
+    }
+    for name, data in cases.items():
+        path = str(tmp_path / f"events_{name}.jsonl")
+        with open(path, "wb") as f:
+            f.write(data)
+        batch_events, batch_meta = T.read_stream(path)
+        evs, meta = _tailer_replay(data, chunk)
+        assert evs == batch_events, (name, chunk)
+        assert meta["events"] == batch_meta["events"], (name, chunk)
+        assert meta["torn_tail"] == batch_meta["torn_tail"], (name, chunk)
+        assert meta["corrupt_lines"] == batch_meta["corrupt_lines"], \
+            (name, chunk)
+
+
+def test_tailer_torn_tail_completes_later(tmp_path):
+    """A torn tail is PENDING, not corrupt: when the writer's next flush
+    completes the line, the held prefix joins it into one event."""
+    path = str(tmp_path / "events_peer0.jsonl")
+    line = json.dumps({"ev": "round", "peer": 0, "seq": 0, "round": 0,
+                       "wall_s": 0.1}).encode() + b"\n"
+    with open(path, "wb") as f:
+        f.write(line[:10])
+    t = StreamTailer(path)
+    assert t.poll() == []           # mid-write: nothing completed yet
+    assert t.corrupt_so_far == 0    # and nothing counted corrupt
+    with open(path, "ab") as f:
+        f.write(line[10:])
+    evs = t.poll()
+    assert len(evs) == 1 and evs[0]["round"] == 0
+    _tail, meta = t.finalize()
+    assert meta == {"path": path, "events": 1, "torn_tail": False,
+                    "corrupt_lines": 0}
+
+
+def test_tailer_bounded_reads(tmp_path):
+    """poll() with a tiny chunk budget still drains the whole backlog."""
+    path = str(tmp_path / "events_peer0.jsonl")
+    w = T.EventWriter(path, peer=0, flush_every=1)
+    for r in range(50):
+        w.emit("round", round=r, wall_s=0.1)
+    w.close()
+    t = StreamTailer(path)
+    evs = t.poll(chunk_bytes=7)
+    assert [e["round"] for e in evs] == list(range(50))
+
+
+# ---------------------------------------------- streaming invariant parity
+
+
+def test_streaming_registry_mirrors_batch():
+    assert set(STREAMING_CHECKS) == set(INVARIANTS)
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 17, 1 << 20])
+def test_streaming_batch_parity_all_fixtures(chunk):
+    """THE parity contract: on every seeded fixture, streaming verdicts ==
+    batch verdicts under adversarial chunk boundaries."""
+    for name, events, firing in _fixtures():
+        batch = run_invariants(T.causal_order(events))
+        stream = _stream_verdict(events, chunk)
+        assert _norm(stream) == _norm(batch), (name, chunk)
+        fired = {k for k, v in stream.items() if v}
+        assert fired == firing, (name, chunk, fired)
+
+
+def test_streaming_violations_fire_before_finalize():
+    """Liveness: the decidable violations surface during feed, not only
+    at finalize — the soak's fail-fast gate depends on it."""
+    for name, events, firing in _fixtures():
+        if not firing:
+            continue
+        streams = _streams_of(events)
+        suite = StreamingInvariantSuite()
+        tailers = {p: StreamTailer(p) for p in streams}
+        for p, data in streams.items():
+            for e in tailers[p].feed_bytes(data):
+                suite.feed(e)
+        live = {k for k, c in suite.checks.items() if c.out}
+        assert firing <= live, (name, live)
+
+
+def test_streaming_acked_retracts_on_receiver_restart():
+    """A verdict fired against a receiver whose stream later shows a
+    second incarnation is retracted (the batch check never judges a
+    restarted receiver)."""
+    events = tt._clean_run()
+    del events[5]                       # the lost-acked corruption...
+    suite = StreamingInvariantSuite()
+    for e in events:
+        suite.feed(e)
+    assert suite.checks["acked_not_lost"].out   # fired live
+    # ...then a restarted incarnation of B appends to the same stream
+    late = _ev("run.start", "B", 0, 30.0, role="peer")
+    late["pid"] = 99999
+    suite.feed(late)
+    assert suite.checks["acked_not_lost"].out == []
+    assert suite.finalize()["acked_not_lost"] == []
+    batch = run_invariants(T.causal_order(events + [late]))
+    assert batch["acked_not_lost"] == []
+
+
+# ------------------------------------------------------------------- health
+
+
+def _soak_like_events():
+    return [
+        _ev("run.start", "B", 0, 9.0, role="peer"),
+        _send("A", 0, 10.0, to="B", msg_id=0, **{}),
+        dict(_send("A", 1, 10.5, to="B", msg_id=1), bytes=1000),
+        _recv("B", 1, 10.6, src="A", msg_id=0),
+        _recv("B", 2, 10.7, src="A", msg_id=1),
+        _ev("resource", "B", 3, 10.8, rss_gb=1.5, cpu_percent=42.0),
+        dict(_merge("B", 4, 11.0, version=1,
+                    arrivals=[_arrival("A", 0, staleness=1, weight=2.0),
+                              _arrival("A", 1, staleness=3, weight=1.0)],
+                    component=["A", "B"]),
+             trust={"A": 0.9, "B": 1.0}, effective_rank=1.8),
+        dict(_send("A", 2, 11.5, to="B", msg_id=2), bytes=500),
+        _recv("B", 5, 11.6, src="A", msg_id=2),
+        dict(_merge("B", 6, 14.0, version=2,
+                    arrivals=[_arrival("A", 2, staleness=0, weight=1.0)],
+                    component=["A", "B"]),
+             trust={"A": 0.2, "B": 1.0}),
+    ]
+
+
+def test_health_rollup_per_merge_record():
+    h = HealthRollup()
+    recs = [r for r in map(h.feed, _soak_like_events()) if r is not None]
+    assert len(recs) == 2
+    r1, r2 = recs
+    assert r1["round"] == 1 and r1["arrivals"] == 2
+    assert r1["bytes_wire"] == 1000 and r1["sends_ok"] == 2
+    assert r1["recv_accepted"] == 2
+    assert r1["staleness_p50"] == 1 and r1["staleness_p95"] == 3
+    assert (r1["weight_min"], r1["weight_max"]) == (1.0, 2.0)
+    assert r1["trust"] == {"A": 0.9, "B": 1.0}
+    assert r1["effective_rank"] == 1.8
+    assert r1["resource"]["B"]["rss_gb"] == 1.5
+    assert r1["round_gap_s"] is None
+    # window counters reset per record; the gap spans merge-to-merge
+    assert r2["bytes_wire"] == 500 and r2["sends_ok"] == 1
+    assert abs(r2["round_gap_s"] - 3.0) < 1e-9
+    assert r2["trust"]["A"] == 0.2
+
+
+def test_alert_lifecycle_fire_heal_and_severity_gate():
+    th = AlertThresholds(trust_warn=0.35, rss_critical_gb=2.0)
+    alerts = AlertManager(th)
+    h = HealthRollup()
+    fired = []
+    for e in _soak_like_events():
+        rec = h.feed(e)
+        if rec is not None:
+            fired.extend(evaluate_health_alerts(alerts, rec))
+    # round 2 dropped A's trust below the floor: exactly one warn fire
+    trust_alerts = [a for a in fired if a["what"] == "trust_low"]
+    assert len(trust_alerts) == 1
+    assert trust_alerts[0]["severity"] == WARN
+    assert trust_alerts[0]["key"] == "A"
+    # a warn never gates: no unhealed criticals
+    assert alerts.unhealed(CRITICAL) == []
+    # recovery heals exactly once
+    rec = h.feed(dict(_merge("B", 7, 15.0, version=3,
+                             arrivals=[_arrival("A", 3)],
+                             component=["A", "B"]),
+                      trust={"A": 0.9, "B": 1.0}))
+    healed = [a for a in evaluate_health_alerts(alerts, rec)
+              if a.get("healed")]
+    assert [a["what"] for a in healed] == ["trust_low"]
+    # a critical fires at the rss threshold and gates until healed
+    rec2 = h.feed(_ev("resource", "B", 8, 15.5, rss_gb=3.0,
+                      cpu_percent=10.0))
+    assert rec2 is None
+    rec3 = h.feed(dict(_merge("B", 9, 16.0, version=4,
+                              arrivals=[_arrival("A", 4)],
+                              component=["A", "B"])))
+    crit = [a for a in evaluate_health_alerts(alerts, rec3)
+            if a["severity"] == CRITICAL]
+    assert [a["what"] for a in crit] == ["rss_high"]
+    assert alerts.unhealed(CRITICAL) != []
+
+
+# ---------------------------------------------------------- live collator
+
+
+def _write_stream(dirpath, peer, events):
+    path = os.path.join(str(dirpath), f"events_peer{peer}.jsonl")
+    with open(path, "wb") as f:
+        for e in events:
+            f.write(json.dumps(e).encode() + b"\n")
+    return path
+
+
+def test_live_collator_matches_batch_collate(tmp_path):
+    for name, events, _firing in _fixtures():
+        d = tmp_path / name
+        d.mkdir()
+        by_peer = {}
+        for e in events:
+            by_peer.setdefault(str(e["peer"]), []).append(e)
+        paths = [_write_stream(d, p, evs) for p, evs in by_peer.items()]
+        batch = T.collate(paths)
+        lc = LiveCollator(str(d))
+        summary = lc.finalize()
+        assert summary["invariants"] == batch["invariants"], name
+        assert summary["events"] == batch["timeline"]["events"], name
+        assert summary["torn_tails"] == batch["torn_tails"], name
+        assert summary["ok"] == batch["ok"] or not batch["ok"], name
+
+
+def test_live_collator_picks_up_streams_mid_run(tmp_path):
+    _write_stream(tmp_path, "A", [_send("A", 0, 10.0, to="B", msg_id=0)])
+    lc = LiveCollator(str(tmp_path))
+    lc.sweep()
+    assert len(lc.tailers) == 1 and lc.events == 1
+    # a second peer's stream appears after monitoring began
+    _write_stream(tmp_path, "B", [_recv("B", 0, 10.2, src="A", msg_id=0),
+                                  _end("B", 1, 20.0)])
+    lc.sweep()
+    assert len(lc.tailers) == 2 and lc.events == 3
+    assert not lc.all_closed()      # A's stream never closed
+    with open(os.path.join(str(tmp_path), "events_peerA.jsonl"),
+              "ab") as f:
+        f.write(json.dumps(_end("A", 1, 20.0)).encode() + b"\n")
+    lc.sweep()
+    assert lc.all_closed()
+    assert lc.finalize()["ok"]
+
+
+def test_live_collator_emits_health_and_alert_events(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    by_peer = {}
+    for e in _soak_like_events():
+        by_peer.setdefault(str(e["peer"]), []).append(e)
+    for p, evs in by_peer.items():
+        _write_stream(run, p, evs)
+    health_path = str(tmp_path / "health.jsonl")
+    T.install(T.EventWriter(health_path, run="monitor", flush_every=1))
+    try:
+        lc = LiveCollator(str(run),
+                          thresholds=AlertThresholds(trust_warn=0.35))
+        lc.finalize()
+    finally:
+        T.uninstall()
+    events, meta = T.read_stream(health_path)
+    assert meta["corrupt_lines"] == 0 and not meta["torn_tail"]
+    kinds = {e["ev"] for e in events}
+    assert kinds == {"health", "alert"}   # catalogued types only
+    health = [e for e in events if e["ev"] == "health"]
+    assert [h["round"] for h in health] == [1, 2]
+    assert health[0]["trust"] == {"A": 0.9, "B": 1.0}
+    alerts = [e for e in events if e["ev"] == "alert"]
+    assert any(a["what"] == "trust_low" and a["severity"] == "warn"
+               for a in alerts)
+
+
+# -------------------------------------------------------------- monitor CLI
+
+
+def test_monitor_cli_clean_run_exits_zero(tmp_path, capsys):
+    run = tmp_path / "run"
+    run.mkdir()
+    by_peer = {}
+    for e in tt._clean_run():
+        by_peer.setdefault(str(e["peer"]), []).append(e)
+    for p, evs in by_peer.items():
+        _write_stream(run, p, evs)
+    summary_path = str(tmp_path / "summary.json")
+    rc = monitor_main([str(run), "--once", "--quiet",
+                       "--summary-out", summary_path])
+    assert rc == 0
+    with open(summary_path) as f:
+        summary = json.load(f)
+    assert summary["ok"] and summary["invariant_violations_total"] == 0
+    assert summary["health_records"] == 2
+    assert os.path.exists(os.path.join(str(run), "health.jsonl"))
+    # health.jsonl is outside the events_*.jsonl glob: trace never
+    # ingests the observer's own stream
+    assert os.path.join(str(run), "health.jsonl") not in \
+        T.find_streams(str(run))
+
+
+def test_monitor_cli_flags_seeded_violation_while_stream_open(tmp_path):
+    """The chaos_smoke monitor-leg contract: a double-merge in a stream
+    that has NOT closed (no run.end — the run is still alive) must exit
+    nonzero."""
+    run = tmp_path / "run"
+    run.mkdir()
+    events = tt._clean_run()
+    events[3]["arrivals"] = [_arrival("A", 0)]   # the double merge
+    events = [e for e in events if e["ev"] != "run.end"]  # still alive
+    by_peer = {}
+    for e in events:
+        by_peer.setdefault(str(e["peer"]), []).append(e)
+    for p, evs in by_peer.items():
+        _write_stream(run, p, evs)
+    rc = monitor_main([str(run), "--once", "--quiet",
+                       "--health-out", "off"])
+    assert rc == 1
+
+
+def test_monitor_cli_no_streams_exits_two(tmp_path):
+    rc = monitor_main([str(tmp_path), "--once", "--quiet",
+                       "--health-out", "off"])
+    assert rc == 2
+
+
+# ------------------------------------------------- resource sampling mode
+
+
+def test_resource_monitor_periodic_sampling(tmp_path):
+    import time
+
+    from bcfl_tpu.metrics import ResourceMonitor
+
+    path = str(tmp_path / "events_rs.jsonl")
+    T.install(T.EventWriter(path, peer=7, run="rs", flush_every=1))
+    try:
+        m = ResourceMonitor()
+        assert m.start_sampling(0.02)
+        assert not m.start_sampling(0.02)   # idempotent while running
+        time.sleep(0.15)
+        m.stop_sampling()
+        m.stop_sampling()                   # idempotent when stopped
+    finally:
+        T.uninstall()
+    events, meta = T.read_stream(path)
+    samples = [e for e in events if e["ev"] == "resource"]
+    assert len(samples) >= 2                # actually periodic
+    assert meta["corrupt_lines"] == 0
+    for s in samples:
+        assert s["rss_gb"] > 0 and s["cpu_percent"] >= 0
+        assert s["peer"] == 7               # rides the process stream
+    # the health series picks the samples up
+    h = HealthRollup()
+    for s in samples:
+        h.feed(s)
+    rec = h.feed(_merge("B", 0, 10.0, version=1,
+                        arrivals=[_arrival("A", 0)]))
+    assert rec["resource"]["7"]["rss_gb"] > 0

@@ -348,6 +348,15 @@ def test_quarantine_without_evidence_detected():
     trans2 = dict(trans, seq=6)
     out2 = T.run_invariants(T.causal_order(base + [evid, trans2]))
     assert out2["quarantine_evidence"] == []
+    # a from="restored" re-declaration is exempt WITHOUT local evidence:
+    # a resumed follower replays quarantines it absorbed from the
+    # leader's committed chain rows — the evidence lives in the leader's
+    # stream, not its own (exposed by the dist_soak churn lane)
+    restored = _ev("rep.transition", "B", 5, 13.0, client=2, trust=0.3,
+                   scope="peer",
+                   **{"from": "restored", "to": "quarantined"})
+    out3 = T.run_invariants(T.causal_order(base + [restored]))
+    assert out3["quarantine_evidence"] == []
 
 
 def test_shrinking_chain_detected_and_rewrite_exempt():

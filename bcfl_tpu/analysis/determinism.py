@@ -52,6 +52,12 @@ SEEDED_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     # votes_by_peer construction: peer iteration order reaches the
     # lineage record and the krum-selected-peer translation
     "dist/runtime.py": ("_apply_robust_merge",),
+    # gossip's pure seams: the seeded neighbor draw (topology replay),
+    # the canonical-order commutative merge, and the state digest — the
+    # GossipPeerRuntime class around them is wall-clock country
+    # (hello cadence, drain windows, arrival latencies)
+    "dist/gossip.py": ("sample_neighbors", "merge_states",
+                       "state_digest", "_walk_sorted"),
 }
 
 _WALLCLOCK = {"time", "monotonic", "time_ns", "monotonic_ns",

@@ -191,6 +191,25 @@ class DistConfig:
     # monitor's health series can track drift across a long soak.
     # 0.0 (default) = off; ignored when telemetry is off.
     resource_sample_s: float = 0.0
+    # --- dispatch mode (RUNTIME.md "Gossip dispatch") ---
+    # "leader" = the FedBuff path above: min reachable id owns the merge,
+    # the robust votes, and the reputation clock for its component.
+    # "gossip" = leaderless epidemic exchange (bcfl_tpu.dist.gossip): every
+    # peer samples seeded neighbors per local round, pushes its full state,
+    # and merges arrivals with a commutative version-vector rule — no
+    # privileged process, elastic membership (bcfl_tpu.dist.membership).
+    dispatch: str = "leader"
+    # gossip neighbors contacted per local round (epidemic fan-out, or the
+    # ring successor count under gossip_topology="ring")
+    gossip_fanout: int = 2
+    # neighbor-sampling topology: "epidemic" draws gossip_fanout live peers
+    # from a PRNG keyed (seed, round, peer) — replayable; "ring" takes the
+    # next gossip_fanout successors around the sorted live view
+    gossip_topology: str = "epidemic"
+    # HELLO beacon cadence (seconds): each peer periodically hellos one
+    # sampled neighbor and any peer answers with a state+chain sync — the
+    # steady-state resync that makes join/leave mid-run continuous
+    gossip_hello_interval_s: float = 5.0
 
     def __post_init__(self):
         if self.peers < 2:
@@ -232,6 +251,26 @@ class DistConfig:
             raise ValueError(
                 f"resource_sample_s must be >= 0, got "
                 f"{self.resource_sample_s}")
+        if self.dispatch not in ("leader", "gossip"):
+            raise ValueError(
+                f"dist dispatch must be 'leader' or 'gossip', got "
+                f"{self.dispatch!r}")
+        if self.gossip_topology not in ("epidemic", "ring"):
+            raise ValueError(
+                f"gossip_topology must be 'epidemic' or 'ring', got "
+                f"{self.gossip_topology!r}")
+        if self.gossip_fanout < 1:
+            raise ValueError(
+                f"gossip_fanout must be >= 1, got {self.gossip_fanout}")
+        if self.dispatch == "gossip" and self.gossip_fanout >= self.peers:
+            raise ValueError(
+                f"gossip_fanout {self.gossip_fanout} must be < peers "
+                f"{self.peers} (a peer cannot gossip to more neighbors "
+                "than exist besides itself)")
+        if self.gossip_hello_interval_s <= 0:
+            raise ValueError(
+                f"gossip_hello_interval_s must be > 0, got "
+                f"{self.gossip_hello_interval_s}")
 
 
 # --- runtime capability table (RUNTIME.md §2) --------------------------------
@@ -262,9 +301,9 @@ RUNTIME_CAPS: Tuple = (
     ("serverless gossip mode",
      lambda c: c.mode == "serverless",
      {"local": True,
-      "dist": "the dist runtime exchanges updates through per-component "
-              "FedBuff leaders; the ring-gossip topology has no wire "
-              "protocol yet — use mode='server'"}),
+      "dist": "the dist runtime's serverless analogue is the leaderless "
+              "dispatch, not the local ring-gossip diffusion — use "
+              "mode='server' with dist.dispatch='gossip'"}),
     ("simulated-clock sync rounds",
      lambda c: c.sync == "sync",
      {"local": True,
@@ -387,6 +426,29 @@ RUNTIME_CAPS: Tuple = (
       "dist": "kill the peer PROCESS instead (scripts/dist_async.py "
               "--kill-peer): a real crash is the thing itself, not a "
               "simulated one"}),
+    # --- gossip-dispatch composition rows (RUNTIME.md "Gossip dispatch"):
+    # active only when the dist runtime is asked for dispatch='gossip', so
+    # they never fire for local runs or the leadered dist path ---
+    ("communication compression under gossip dispatch",
+     lambda c: c.compression.enabled and c.dist.dispatch == "gossip",
+     {"local": True,
+      "dist": "the codec wire encodes DELTAS against a shared adopted "
+              "base version; gossip peers merge concurrently with no "
+              "common base to delta against — use compress='none'"}),
+    ("krum under gossip dispatch",
+     lambda c: c.aggregator == "krum" and c.dist.dispatch == "gossip",
+     {"local": True,
+      "dist": "krum selects ONE vote from a population; over a gossip "
+              "peer's tiny neighbor arrival set the selection guarantee "
+              "is vacuous and the merge would just adopt one neighbor "
+              "verbatim — use trimmed_mean or median"}),
+    ("chaos: transport partition under gossip dispatch",
+     lambda c: c.faults.partitions and c.dist.dispatch == "gossip",
+     {"local": True,
+      "dist": "the partition fork/reconcile heal protocol is a leadered "
+              "construct (peer 0 arbitrates the reconcile); gossip "
+              "handles unreachable peers through detector-driven "
+              "membership instead — drop partitions from the fault plan"}),
     ("per-round central eval",
      lambda c: c.eval_every != 0,
      {"local": True,
@@ -723,8 +785,23 @@ class FedConfig:
                     krum_min_buffer,
                 )
 
+                if self.dist.dispatch == "gossip":
+                    # gossip has no leader buffer: the rule's population
+                    # is a peer's local round arrival set — at most its
+                    # sampled neighbors plus its own state. krum is
+                    # already rejected by the caps table above.
+                    if self.dist.gossip_fanout + 1 < MIN_ORDER_VOTES:
+                        raise ValueError(
+                            f"aggregator={self.aggregator!r} under "
+                            f"dispatch='gossip' needs gossip_fanout >= "
+                            f"{MIN_ORDER_VOTES - 1} (got "
+                            f"{self.dist.gossip_fanout}): the rule's "
+                            "population is a peer's neighbor arrival set "
+                            "plus itself, and an order statistic over < "
+                            f"{MIN_ORDER_VOTES} votes excludes nothing")
                 eff = self.dist.buffer or 1
-                if self.aggregator in ("trimmed_mean", "median"):
+                if (self.aggregator in ("trimmed_mean", "median")
+                        and self.dist.dispatch != "gossip"):
                     if eff < MIN_ORDER_VOTES:
                         raise ValueError(
                             f"aggregator={self.aggregator!r} on "

@@ -383,6 +383,21 @@ class PeerRuntime:
                 break
             del self.history[v]
 
+    # --- dispatch-mode extension hooks (gossip.py overrides these) ---
+
+    def _checkpoint_extra(self) -> Dict:
+        """Extra keys a dispatch subclass folds into the checkpoint state."""
+        return {}
+
+    def _restore_extra(self, state: Dict) -> None:
+        """Dispatch-subclass twin of :meth:`_checkpoint_extra` on restore.
+        Called from ``_restore`` (inside ``__init__`` when resume=True), so
+        subclasses must pre-set any attributes it touches BEFORE super()."""
+
+    def _report_extra(self) -> Dict:
+        """Extra keys a dispatch subclass folds into the peer report."""
+        return {}
+
     def _cast(self, tree):
         import jax.numpy as jnp
 
@@ -1219,6 +1234,7 @@ class PeerRuntime:
             # resumed leader re-enters with every trust score and
             # quarantine timer exactly where the crash left them
             state.update(self.rep.checkpoint_state())
+        state.update(self._checkpoint_extra())
         save_checkpoint(self.ckpt_dir, self.version, state,
                         self.chain.to_json()
                         if self.chain is not None else None)
@@ -1280,6 +1296,7 @@ class PeerRuntime:
         self.history = {
             self.version: (self.trainable if self.eng._comp is None
                            else None, self._head())}
+        self._restore_extra(state)
         self._resumed = True
         logger.info("peer %d: restored checkpoint at version %d "
                     "(round %d)", self.peer_id, self.version,
@@ -1535,6 +1552,7 @@ class PeerRuntime:
             "events": self.events_path,
             "wall_s": time.time() - self._t0,
         }
+        report.update(self._report_extra())
         path = os.path.join(self.run_dir, f"report_peer{self.peer_id}.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -1580,6 +1598,12 @@ def peer_main(argv=None) -> int:
     with open(args.config) as f:
         cfg = cfg_from_json(f.read())
     ports = [int(p) for p in args.ports.split(",")]
-    rt = PeerRuntime(cfg, args.peer_id, ports, args.run_dir,
-                     resume=args.resume)
+    if cfg.dist.dispatch == "gossip":
+        # leaderless epidemic dispatch (RUNTIME.md "Gossip dispatch"):
+        # same transport, same engine, no privileged process
+        from bcfl_tpu.dist.gossip import GossipPeerRuntime as Runtime
+    else:
+        Runtime = PeerRuntime
+    rt = Runtime(cfg, args.peer_id, ports, args.run_dir,
+                 resume=args.resume)
     return rt.run()

@@ -35,7 +35,11 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
-from bcfl_tpu.telemetry.invariants import INVARIANTS, run_invariants
+from bcfl_tpu.telemetry.invariants import (
+    INVARIANTS,
+    MERGE_EVS,
+    run_invariants,
+)
 
 
 # --------------------------------------------------------------------- read
@@ -258,7 +262,12 @@ def summarize(ordered: List[Dict]) -> Dict:
         elif ev == "phase":
             phases.setdefault(str(p), {}).setdefault(
                 e.get("name"), []).append(float(e.get("wall_s") or 0.0))
-        elif ev == "merge":
+        elif ev in MERGE_EVS:
+            # leadered merges and gossip (per-peer) merges roll up into
+            # the same lineage counters; under gossip the unique-id
+            # tally is scoped by the MERGING peer (first key), so two
+            # peers each merging the same broadcast epoch's ids is not
+            # double-counted as a dedup anomaly
             merge["count"] += 1
             merge["rejected"] += len(e.get("rejected") or [])
             if e.get("solo"):

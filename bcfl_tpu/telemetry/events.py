@@ -73,6 +73,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     "fork.begin": ("at_version", "component"),
     "fork.heal": ("at_version",),
     "reconcile": ("from_peer",),
+    # --- gossip dispatch (bcfl_tpu.dist.gossip, RUNTIME.md "Gossip
+    # dispatch"): one neighbor exchange per local round, and the peer-local
+    # commutative merge — same required shape as "merge" so every
+    # merge-scoped invariant can treat the two uniformly (the merging peer
+    # fills the "leader" slot: it IS the merge authority for its own state)
+    "gossip.exchange": ("round", "neighbors"),
+    "gossip.merge": ("version", "leader", "arrivals", "rejected", "solo",
+                     "degraded", "component", "wall_s"),
+    # --- elastic membership (bcfl_tpu.dist.membership): one peer's LOCAL
+    # live-view transitions (member joined/left the view, not the cluster)
+    "membership.join": ("member", "live"),
+    "membership.leave": ("member", "reason", "live"),
     # --- ledger (length-bearing; the monotone-heads invariant reads these)
     "ledger": ("op", "chain_len", "rewrite"),  # op: commit|append|resync|adopt_merge
     # --- checkpoints (bcfl_tpu.checkpoint) ---

@@ -57,6 +57,15 @@ from typing import Dict, List
 # run.end are not judged
 ACK_GRACE_S = 1.0
 
+# the event names that record a model-composition step. The merge-scoped
+# rules key their per-merger state on the event's OWN (peer, pid) — under
+# leadered dispatch that is the component leader; under gossip dispatch
+# (RUNTIME.md "Gossip dispatch") EVERY peer merges and fills the "leader"
+# slot with itself, and the same rules hold per merging peer with no code
+# fork: dedup identities, component membership, and quarantine verdicts
+# are all per-merger facts, not global ones.
+MERGE_EVS = ("merge", "gossip.merge")
+
 
 def _peer_of(e: Dict):
     return e.get("peer")
@@ -71,7 +80,7 @@ def no_double_merge(events: List[Dict]) -> List[Dict]:
     seen = {}
     out = []
     for e in events:
-        if e.get("ev") != "merge":
+        if e.get("ev") not in MERGE_EVS:
             continue
         leader = (_peer_of(e), e.get("pid"))
         for a in e.get("arrivals") or []:
@@ -154,7 +163,7 @@ def acked_not_lost(events: List[Dict]) -> List[Dict]:
 def no_cross_partition_merge(events: List[Dict]) -> List[Dict]:
     out = []
     for e in events:
-        if e.get("ev") != "merge":
+        if e.get("ev") not in MERGE_EVS:
             continue
         comp = e.get("component")
         if not comp:
@@ -246,7 +255,7 @@ def no_quarantined_merge(events: List[Dict]) -> List[Dict]:
                 q.add(e.get("client"))
             else:
                 q.discard(e.get("client"))
-        elif ev == "merge":
+        elif ev in MERGE_EVS:
             q = quarantined.get(key)
             if not q:
                 continue

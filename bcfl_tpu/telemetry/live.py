@@ -54,7 +54,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from bcfl_tpu.telemetry import events as _telemetry
 from bcfl_tpu.telemetry.collate import find_streams
-from bcfl_tpu.telemetry.invariants import ACK_GRACE_S, INVARIANTS
+from bcfl_tpu.telemetry.invariants import ACK_GRACE_S, INVARIANTS, MERGE_EVS
 
 
 # ----------------------------------------------------------------- tailing
@@ -195,7 +195,7 @@ class SNoDoubleMerge(_StreamingCheck):
         self._seen: Dict = {}   # ((leader, pid), peer, epoch, id) -> version
 
     def feed(self, e: Dict) -> List[Dict]:
-        if e.get("ev") != "merge":
+        if e.get("ev") not in MERGE_EVS:
             return []
         leader = (e.get("peer"), e.get("pid"))
         new: List[Dict] = []
@@ -319,7 +319,7 @@ class SNoCrossPartitionMerge(_StreamingCheck):
     name = "no_cross_partition_merge"
 
     def feed(self, e: Dict) -> List[Dict]:
-        if e.get("ev") != "merge":
+        if e.get("ev") not in MERGE_EVS:
             return []
         comp = e.get("component")
         if not comp:
@@ -410,7 +410,7 @@ class SNoQuarantinedMerge(_StreamingCheck):
             else:
                 q.discard(e.get("client"))
             return []
-        if ev != "merge":
+        if ev not in MERGE_EVS:
             return []
         q = self._quarantined.get(key)
         if not q:
@@ -613,7 +613,12 @@ class HealthRollup:
                     self._trust[str(e.get("client"))] = float(e["trust"])
                 except (TypeError, ValueError):
                     pass
-        elif ev == "merge":
+        elif ev in MERGE_EVS:
+            # under gossip dispatch every peer's merge feeds the series
+            # (the "round clock" is the union of per-peer merge clocks) —
+            # in particular the monitor's wall-stall watchdog keys on
+            # last_merge_t, and a leaderless run has no single leader
+            # whose "merge" events could keep it fed
             return self._merge_record(e)
         return None
 

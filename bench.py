@@ -40,7 +40,11 @@ measured dist row (ROADMAP "Hot-path speed"). Dist knobs:
 BCFL_BENCH_PEERS (default 3), BCFL_BENCH_DIST_ROUNDS (target versions,
 default 6), BCFL_BENCH_DIST_MODEL (default tiny-bert — peers each compile
 their own engine), BCFL_BENCH_DIST_PIPELINE=0 disables the comms/compute
-overlap pipeline (the A/B axis scripts/wire_perf.py sweeps).
+overlap pipeline (the A/B axis scripts/wire_perf.py sweeps), and
+BCFL_BENCH_DIST_DISPATCH={leader,gossip} selects the execution mode
+(RUNTIME.md "Gossip dispatch") — the gossip row lands under its own
+metric name (dist_fed_gossip_samples_per_sec) so the leaderless
+throughput sits NEXT to the leadered one instead of overwriting it.
 BCFL_BENCH_COMPRESS={none,int8,topk,int8+topk} compiles the update-exchange
 codec (COMPRESSION.md) into the timed round program and adds bytes-on-wire
 fields to the JSON line — the throughput-per-codec axis of the
@@ -70,6 +74,9 @@ ROUNDS = int(os.environ.get("BCFL_BENCH_ROUNDS", "32"))  # fed rounds / dispatch
 STEPS = int(os.environ.get("BCFL_BENCH_STEPS", "8"))  # local batches / round
 ITERS = int(os.environ.get("BCFL_BENCH_ITERS", "2"))  # timed dispatches
 MODE = os.environ.get("BCFL_BENCH_MODE", "server")  # server | serverless
+# dist execution mode: "leader" (per-component FedBuff funnel) or
+# "gossip" (leaderless epidemic dispatch); validated in main() like MODE
+DIST_DISPATCH = os.environ.get("BCFL_BENCH_DIST_DISPATCH", "leader")
 # update-exchange codec compiled into the timed program (COMPRESSION.md).
 # COMPRESS_KINDS must match bcfl_tpu.compression.KINDS — kept literal here
 # because nothing may import the package (and with it jax) before the
@@ -132,6 +139,10 @@ def _emit(obj):
 
 def _metric_name():
     if MODE == "dist":
+        # one metric per dispatch mode: the leaderless row must not
+        # overwrite the leadered baseline it is compared against
+        if DIST_DISPATCH == "gossip":
+            return "dist_fed_gossip_samples_per_sec"
         return "dist_fed_async_samples_per_sec"
     tag = "serverless_" if MODE == "serverless" else ""
     return f"bert-base_fed_{tag}finetune_samples_per_sec_per_chip"
@@ -268,8 +279,11 @@ def _dist_bench(watchdog):
         partition=PartitionConfig(kind="iid", iid_samples=8),
         ledger=LedgerConfig(enabled=True),
         compression=CompressionConfig(kind=COMPRESS),
+        # dispatch="gossip" rides the same knobs; the fanout is clamped
+        # below the fleet size (the config rejects fanout >= peers)
         dist=DistConfig(peers=peers, peer_deadline_s=deadline,
-                        pipeline=pipeline),
+                        pipeline=pipeline, dispatch=DIST_DISPATCH,
+                        gossip_fanout=max(1, min(2, peers - 1))),
     )
     run_dir = tempfile.mkdtemp(prefix="bcfl_bench_dist_")
     watchdog.stage("dist-run", deadline + 120.0)
@@ -303,6 +317,7 @@ def _dist_bench(watchdog):
         "peers": peers,
         "model": model,
         "pipeline": pipeline,
+        "dispatch": DIST_DISPATCH,
         "compress": COMPRESS,
         "target_versions": versions,
         "final_versions": {str(p): r.get("final_version")
@@ -355,6 +370,10 @@ def main():
         # uncompressed program under a compression label
         _error_json("config", f"unknown BCFL_BENCH_COMPRESS {COMPRESS!r}; "
                     "expected none/int8/topk/int8+topk")
+        sys.exit(1)
+    if DIST_DISPATCH not in ("leader", "gossip"):
+        _error_json("config", "unknown BCFL_BENCH_DIST_DISPATCH "
+                    f"{DIST_DISPATCH!r}; expected 'leader' or 'gossip'")
         sys.exit(1)
     try:
         lora_rank = int(LORA_RANK_RAW or "0")

@@ -437,4 +437,98 @@ assert rc == 1, (f"monitor exit {rc} on a seeded double-merge in an "
 print("monitor leg: seeded mid-run violation detected (exit 1) -- "
       "the live gate is armed")
 EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Gossip leg (RUNTIME.md "Gossip dispatch"): the LEADERLESS dispatch — 3
+# peers exchanging full states with seeded neighbors, wire drop+dup armed
+# at the socket, the would-be leader (peer 0: min peer id, exactly the
+# peer a leadered run elects) SIGKILLed mid-run and LEFT DEAD, a live
+# monitor attached throughout. Gates: both survivors carry their own
+# version clocks to the horizon (zero round stall beyond the
+# failure-detector window — no election, no handoff, no merge authority
+# to lose), the monitor exits 0, the batch trace is clean with
+# monitor-parity, and the kill is OBSERVED as membership.leave
+# transitions in the survivors' streams. The long-horizon composition
+# (wire + byzantine + churn + the leadered-twin convergence gate) is
+# scripts/dist_soak.py --dispatch gossip -> results/dist_soak.json.
+echo
+echo "gossip leg: 3 leaderless peers, wire drop+dup, mid-run SIGKILL of peer 0"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
+                             PartitionConfig)
+from bcfl_tpu.dist.harness import run_dist
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.telemetry import collate, read_stream
+
+run_dir = "/tmp/bcfl_chaos_gossip_run"
+if os.path.isdir(run_dir):
+    shutil.rmtree(run_dir)
+os.makedirs(run_dir)
+stop = os.path.join(run_dir, "monitor.stop")
+summary_path = "/tmp/bcfl_chaos_gossip_summary.json"
+mon = subprocess.Popen(
+    [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor", run_dir,
+     "--quiet", "--poll", "0.5", "--stop-file", stop,
+     "--summary-out", summary_path, "--max-wall", "500", "--idle", "400",
+     "--stall-critical-s", "600"])
+cfg = FedConfig(
+    name="gossip_smoke", runtime="dist", mode="server", sync="async",
+    model="tiny-bert", dataset="synthetic", num_clients=6, num_rounds=5,
+    seq_len=16, batch_size=4, max_local_batches=2, eval_every=0, seed=42,
+    partition=PartitionConfig(kind="iid", iid_samples=8),
+    ledger=LedgerConfig(enabled=True),
+    faults=FaultPlan(seed=7, wire_drop_prob=0.2, wire_dup_prob=0.2),
+    dist=DistConfig(peers=3, dispatch="gossip", gossip_fanout=2,
+                    buffer_timeout_s=10.0, idle_timeout_s=90.0,
+                    peer_deadline_s=300.0, checkpoint_every_versions=1,
+                    suspect_after=1))
+try:
+    result = run_dist(cfg, run_dir, deadline_s=400.0, platform="cpu",
+                      kill_peer=0, kill_after_version=1,
+                      restart_killed=False)
+finally:
+    with open(stop, "w") as f:
+        f.write("done\n")
+mon_rc = mon.wait(timeout=120)
+rcs = result["returncodes"]
+reports = result["reports"]
+assert result["kill"] and not result["kill"]["restarted"], result["kill"]
+assert rcs["0"] not in (0, None), f"peer 0 survived the SIGKILL: {rcs}"
+for p in (1, 2):
+    assert rcs[str(p)] == 0, (p, rcs, result["log_tails"].get(p))
+    rep = reports.get(p) or {}
+    assert rep.get("status") == "ok", (p, rep.get("status"))
+    assert (rep.get("final_version") or 0) >= cfg.num_rounds, (
+        "round stall past the failure-detector window", p,
+        rep.get("final_version"))
+    assert rep.get("dispatch") == "gossip", rep.get("dispatch")
+assert mon_rc == 0, f"live monitor exited {mon_rc} on the gossip run"
+col = collate(result["event_streams"])
+col.pop("ordered")
+assert col["ok"], col["violations"]
+with open(summary_path) as f:
+    mon_summary = json.load(f)
+assert mon_summary["invariants"] == col["invariants"], (
+    "monitor-vs-trace verdict drift", mon_summary["invariants"],
+    col["invariants"])
+leaves = gmerges = 0
+for path in result["event_streams"]:
+    evs, _ = read_stream(path)
+    leaves += sum(1 for e in evs if e["ev"] == "membership.leave"
+                  and e.get("member") == 0)
+    gmerges += sum(1 for e in evs if e["ev"] == "gossip.merge")
+assert leaves > 0, "the SIGKILL never surfaced as a membership.leave"
+assert gmerges > 0, "no gossip.merge events in a gossip run"
+print("gossip leg: survivors reached version "
+      f"{[reports[p]['final_version'] for p in (1, 2)]} past the peer-0 "
+      f"SIGKILL, {gmerges} gossip merges, {leaves} membership.leave "
+      "records, monitor + batch trace CLEAN")
+EOF
 exit $?

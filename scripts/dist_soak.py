@@ -40,8 +40,20 @@ Gates (all hard, recorded in ``results/dist_soak.json``):
 - catalogued ``resource`` samples landed in the peers' own streams;
 - every surviving chain replica verifies.
 
+``--dispatch gossip`` soaks the LEADERLESS dispatch (RUNTIME.md "Gossip
+dispatch") under the same wire + byzantine + churn arming, and then runs a
+LEADERED TWIN — identical shape, seed, and chaos plan, ``dispatch="leader"``
+— purely as the convergence reference: the gossip fleet's mean final eval
+loss must land within ``--converge-tol`` (relative) of the twin's. Two
+extra gates ride the gossip lane: the convergence gate above, and
+``membership_churn_observed`` (the churned peer's kill/rejoin cycles must
+show up as catalogued ``membership.leave`` / ``membership.join``
+transitions in the survivors' streams — elastic membership observed, not
+assumed).
+
 Usage: python scripts/dist_soak.py [--rounds 120] [--peers 3]
            [--deadline 2700] [--platform cpu] [--quick]
+           [--dispatch {leader,gossip}]
 """
 
 from __future__ import annotations
@@ -59,11 +71,25 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 
-def build_cfg(args):
+def _mean_final_loss(reports):
+    """Mean terminal eval loss over the peers that computed one.
+
+    Leadered runs finalize on peer 0 only (one entry); gossip peers each
+    evaluate at drain, so this averages the fleet's local verdicts.
+    """
+    losses = [r["final_eval"]["loss"] for r in reports.values()
+              if isinstance(r.get("final_eval"), dict)
+              and r["final_eval"].get("loss") is not None]
+    return (sum(losses) / len(losses)) if losses else None
+
+
+def build_cfg(args, dispatch=None, name="dist_soak"):
     from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
                                  PartitionConfig)
     from bcfl_tpu.faults import FaultPlan
     from bcfl_tpu.reputation import ReputationConfig
+
+    dispatch = dispatch or args.dispatch
 
     plan = FaultPlan(
         seed=args.chaos_seed,
@@ -75,7 +101,7 @@ def build_cfg(args):
         byz_peers=(args.peers - 1,), byz_prob=1.0,
         byz_behaviors=("scale", "digest_forge"))
     return FedConfig(
-        name="dist_soak", runtime="dist", mode="server", sync="async",
+        name=name, runtime="dist", mode="server", sync="async",
         model=args.model, dataset="synthetic",
         num_clients=args.clients, num_rounds=args.rounds,
         seq_len=args.seq_len, batch_size=args.batch_size,
@@ -91,6 +117,10 @@ def build_cfg(args):
         faults=plan,
         dist=DistConfig(
             peers=args.peers, buffer=args.peers,
+            dispatch=dispatch,
+            # full-degree exchange keeps the robust precondition
+            # (fanout + self >= MIN_ORDER_VOTES) at any --peers >= 3
+            gossip_fanout=args.peers - 1,
             buffer_timeout_s=args.buffer_timeout,
             idle_timeout_s=args.idle_timeout,
             peer_deadline_s=args.deadline,
@@ -155,6 +185,15 @@ def main(argv=None) -> int:
                     help="seconds between kill/rejoin cycles of peer 1")
     ap.add_argument("--churn-downtime", type=float, default=2.0)
     ap.add_argument("--resource-sample-s", type=float, default=2.0)
+    ap.add_argument("--dispatch", choices=("leader", "gossip"),
+                    default="leader",
+                    help="dist execution mode; 'gossip' soaks the "
+                         "leaderless dispatch and adds the leadered-twin "
+                         "convergence gate")
+    ap.add_argument("--converge-tol", type=float, default=0.5,
+                    help="gossip lane: max relative gap between the "
+                         "gossip fleet's mean final eval loss and its "
+                         "leadered twin's")
     ap.add_argument("--buffer-timeout", type=float, default=10.0)
     ap.add_argument("--idle-timeout", type=float, default=180.0)
     ap.add_argument("--deadline", type=float, default=2700.0)
@@ -199,7 +238,7 @@ def main(argv=None) -> int:
              "downtime_s": args.churn_downtime,
              "stop_after_s": args.deadline * 0.5}
 
-    print(f"dist_soak: {args.peers} peers x "
+    print(f"dist_soak[{args.dispatch}]: {args.peers} peers x "
           f"{args.clients // args.peers} clients, target {args.rounds} "
           f"versions; wire+byzantine+churn armed, monitor attached live "
           f"-> {run_dir}", flush=True)
@@ -281,15 +320,55 @@ def main(argv=None) -> int:
     from bcfl_tpu.telemetry import read_stream
 
     resource_samples = 0
+    membership_events = 0
     for path in result["event_streams"]:
         evs, _ = read_stream(path)
         resource_samples += sum(1 for e in evs if e["ev"] == "resource")
+        membership_events += sum(
+            1 for e in evs
+            if e["ev"] in ("membership.join", "membership.leave"))
+
+    if args.dispatch == "gossip":
+        # leaderless: there is no peer whose clock speaks for the fleet —
+        # every peer must carry its OWN version to the horizon (this is
+        # also the zero-round-stall gate: a peer stalled behind the
+        # failure-detector window never gets there before the deadline)
+        versions_ok = bool(reports) and all(
+            (r.get("final_version") or 0) >= args.rounds
+            for r in reports.values())
+    else:
+        versions_ok = (leader.get("final_version") or 0) >= args.rounds
+
+    # gossip acceptance (ISSUE 16): the chaos-soaked gossip fleet must
+    # converge within tolerance of its LEADERED TWIN — identical shape,
+    # seed, and wire+byzantine+churn plan, dispatch="leader" — run
+    # sequentially as the reference (no monitor; gates only need its eval)
+    twin = None
+    if args.dispatch == "gossip":
+        twin_dir = run_dir + "_twin"
+        if os.path.isdir(twin_dir):
+            shutil.rmtree(twin_dir)
+        os.makedirs(twin_dir, exist_ok=True)
+        print(f"dist_soak: launching leadered twin (convergence "
+              f"reference) -> {twin_dir}", flush=True)
+        twin_cfg = build_cfg(args, dispatch="leader",
+                             name="dist_soak_twin")
+        twin_result = harness.run_dist(
+            twin_cfg, twin_dir, deadline_s=args.deadline,
+            platform=args.platform, churn=dict(churn))
+        twin_reports = twin_result["reports"]
+        twin = {
+            "run_dir": twin_dir,
+            "ok": twin_result["ok"],
+            "final_versions": {p: r.get("final_version")
+                               for p, r in twin_reports.items()},
+            "loss": _mean_final_loss(twin_reports),
+        }
 
     gates = {
         "fleet_completed": (result["ok"]
                             and len(reports) == args.peers),
-        "target_versions_reached": (
-            (leader.get("final_version") or 0) >= args.rounds),
+        "target_versions_reached": versions_ok,
         "monitor_exit_zero": mon_rc == 0,
         "monitor_never_aborted_fleet": not monitor_aborted,
         "zero_invariant_violations_live": (
@@ -313,8 +392,21 @@ def main(argv=None) -> int:
             rep.get("chain_ok") in (True, None)
             for rep in reports.values()),
     }
+    gossip_loss = None
+    if args.dispatch == "gossip":
+        gossip_loss = _mean_final_loss(reports)
+        # elastic membership must be OBSERVED: the churned peer's
+        # kill/rejoin cycles show up as catalogued membership.leave /
+        # membership.join transitions in the survivors' streams
+        gates["membership_churn_observed"] = membership_events > 0
+        twin_loss = twin["loss"] if twin else None
+        gates["gossip_converged_vs_leadered_twin"] = (
+            gossip_loss is not None and twin_loss is not None
+            and abs(gossip_loss - twin_loss)
+            <= args.converge_tol * max(abs(twin_loss), 1e-6))
     record = {
         "proof": "dist_soak", "peers": args.peers,
+        "dispatch": args.dispatch,
         "clients": args.clients, "target_versions": args.rounds,
         "quick": args.quick,
         "lanes": {
@@ -326,9 +418,15 @@ def main(argv=None) -> int:
                           "state_at_leader": adv_state,
                           "trust_at_leader": adv_trust},
             "churn": {"peer": churn_peer,
-                      "cycles": result.get("churn")},
+                      "cycles": result.get("churn"),
+                      "membership_events": membership_events},
             "resource_sample_s": args.resource_sample_s,
         },
+        "convergence": ({"gossip_loss": gossip_loss,
+                         "twin_loss": twin["loss"] if twin else None,
+                         "tol": args.converge_tol}
+                        if args.dispatch == "gossip" else None),
+        "twin": twin,
         "monitor": {
             "rc": mon_rc,
             "summary": mon_summary,

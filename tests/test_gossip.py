@@ -1,0 +1,434 @@
+"""Leaderless gossip dispatch tests (marker ``dist``, tier-1).
+
+Covers the RUNTIME.md "Gossip dispatch" contracts at three depths:
+
+1. **Pure seams** (no processes, no jax compile): the seeded neighbor
+   draw is replayable and self-excluding (topology = f(seed, round,
+   peer, live view) — the determinism-lint SEEDED_SCOPE entry), the
+   whole-state digest is a function of values not dict insertion order,
+   and the commutative versioned merge is BITWISE independent of
+   arrival order with union version vectors and staleness-decayed
+   weights. Plus the elastic :class:`MembershipView` transitions.
+
+2. **Config surface**: the capability table rejects the compositions
+   gossip cannot honestly run (compression, krum, chaos partitions),
+   the fan-out bounds and the robust-rule vote floor are enforced at
+   construction, and the new DistConfig knobs survive the launch JSON
+   round-trip (the knobs the peer subprocesses are configured through).
+
+3. **Invariant scoping**: ``gossip.merge`` events flow through the SAME
+   batch + streaming invariant checks as leadered ``merge`` — per
+   MERGING peer — with verdict parity between the two engines: a clean
+   two-merger fixture stays clean both ways, a seeded per-merger double
+   merge fires both ways, and two DIFFERENT mergers folding the same
+   sender's updates is legal (dedup identity is a per-merger fact).
+
+The live end-to-end proof — 3 real peer processes, leaderless clocks,
+SIGKILL of the would-be leader, monitor attached — is the gossip leg of
+``scripts/chaos_smoke.sh``; the long-horizon wire+byzantine+churn
+composition with the leadered-twin convergence gate is
+``scripts/dist_soak.py --dispatch gossip``. The tier-1 loopback here
+keeps one REAL multi-process gossip run (clean lanes, 3 peers) inside
+the fast window.
+"""
+
+import numpy as np
+import pytest
+
+from bcfl_tpu.config import DistConfig, FedConfig
+from bcfl_tpu.dist.gossip import (
+    _walk_sorted,
+    merge_states,
+    sample_neighbors,
+    state_digest,
+)
+from bcfl_tpu.dist.membership import MembershipView
+from bcfl_tpu.telemetry.invariants import (
+    INVARIANTS,
+    MERGE_EVS,
+    run_invariants,
+)
+from bcfl_tpu.telemetry.live import StreamingInvariantSuite
+
+pytestmark = pytest.mark.dist
+
+
+# ---------------------------------------------------------- neighbor draw
+
+
+def test_sample_neighbors_replayable_and_self_excluding():
+    live = (0, 1, 2, 3, 4)
+    for peer in live:
+        for rnd in range(6):
+            a = sample_neighbors(7, rnd, peer, live, fanout=2)
+            b = sample_neighbors(7, rnd, peer, live, fanout=2)
+            assert a == b, "same coordinates must draw the same neighbors"
+            assert peer not in a
+            assert len(a) == 2 and len(set(a)) == 2
+            assert all(p in live for p in a)
+
+
+def test_sample_neighbors_varies_by_coordinates():
+    live = tuple(range(8))
+    draws = {sample_neighbors(7, rnd, 0, live, fanout=2)
+             for rnd in range(16)}
+    assert len(draws) > 1, "epidemic fan-out never varied across rounds"
+    # and the seed is a real coordinate too
+    assert {sample_neighbors(8, rnd, 0, live, fanout=2)
+            for rnd in range(16)} != draws
+
+
+def test_sample_neighbors_view_is_an_input():
+    # a departed peer must stop being drawn the moment the view shrinks
+    full = sample_neighbors(7, 3, 0, (0, 1, 2, 3), fanout=3)
+    assert set(full) == {1, 2, 3}
+    shrunk = sample_neighbors(7, 3, 0, (0, 1, 3), fanout=3)
+    assert 2 not in shrunk and set(shrunk) == {1, 3}
+
+
+def test_sample_neighbors_ring_successors():
+    live = (0, 1, 2, 3)
+    assert sample_neighbors(7, 0, 2, live, 2, topology="ring") == (3, 0)
+    assert sample_neighbors(7, 0, 3, live, 1, topology="ring") == (0,)
+    # ring order is view order, not draw order: round is irrelevant
+    assert sample_neighbors(7, 9, 2, live, 2, topology="ring") == (3, 0)
+
+
+def test_sample_neighbors_alone_and_truncated():
+    assert sample_neighbors(7, 0, 1, (1,), fanout=2) == ()
+    assert sample_neighbors(7, 0, 1, (0, 1), fanout=5) == (0,)
+
+
+# ------------------------------------------------------------ state digest
+
+
+def _state(scale=1.0):
+    return {
+        "layer": {"kernel": (np.arange(6, dtype=np.float32)
+                             .reshape(2, 3) * scale),
+                  "bias": np.zeros((3,), np.float32)},
+        "codes": np.array([1, -2], np.int8),
+    }
+
+
+def test_state_digest_order_independent_value_sensitive():
+    a = {"x": np.ones((2,), np.float32), "y": np.zeros((3,), np.int32)}
+    b = {"y": np.zeros((3,), np.int32), "x": np.ones((2,), np.float32)}
+    assert state_digest(a) == state_digest(b)
+    c = {"x": np.ones((2,), np.float32),
+         "y": np.array([0, 0, 1], np.int32)}
+    assert state_digest(a) != state_digest(c)
+    # dtype and shape are identity, not just bytes
+    d = {"x": np.ones((2,), np.float64), "y": np.zeros((3,), np.int32)}
+    assert state_digest(a) != state_digest(d)
+
+
+# ------------------------------------------------------ commutative merge
+
+
+def _item(peer, state, vv, mass=1.0, trust=1.0, order=(1, 0)):
+    return {"peer": peer, "order": order, "state": state,
+            "vv": np.asarray(vv, np.int64), "mass": mass, "trust": trust}
+
+
+def test_merge_states_bitwise_commutative():
+    items = [
+        _item(0, _state(1.0), [3, 1, 0], mass=2.0),
+        _item(1, _state(-0.5), [2, 2, 0], mass=1.0, trust=0.8),
+        _item(2, _state(4.0), [1, 1, 2], mass=1.5, order=(2, 5)),
+    ]
+    ref_state, ref_vv, ref_w = merge_states(list(items), decay=0.9)
+    import itertools
+
+    for perm in itertools.permutations(items):
+        st, vv, w = merge_states(list(perm), decay=0.9)
+        np.testing.assert_array_equal(vv, ref_vv)
+        assert w == ref_w
+        # bitwise, not approx: the digest of the merged state must agree
+        # across peers that saw the same items in any arrival order
+        assert state_digest(st) == state_digest(ref_state)
+        for (pa, la), (pb, lb) in zip(_walk_sorted(st),
+                                      _walk_sorted(ref_state)):
+            assert pa == pb
+            assert la.tobytes() == lb.tobytes(), (
+                f"leaf {pa} not bitwise order-independent")
+
+
+def test_merge_states_union_vv_and_staleness_decay():
+    fresh = _item(0, {"x": np.float32([1.0])}, [4, 0])
+    stale = _item(1, {"x": np.float32([0.0])}, [1, 1])
+    _, union, w = merge_states([fresh, stale], decay=0.5)
+    np.testing.assert_array_equal(union, [4, 1])
+    # union total 5: fresh lags 1 (w=0.5), stale lags 3 (w=0.125)
+    assert w == [0.5, 0.125]
+    # decay=1.0 removes the staleness axis entirely
+    _, _, w1 = merge_states([fresh, stale], decay=1.0)
+    assert w1 == [1.0, 1.0]
+
+
+def test_merge_states_all_eliminated_keeps_first_canonical():
+    a = _item(1, {"x": np.float32([7.0])}, [1, 0], trust=0.0)
+    b = _item(0, {"x": np.float32([9.0])}, [0, 1], trust=0.0)
+    st, union, w = merge_states([a, b], decay=0.9)
+    # canonical order sorts by peer id: peer 0's state survives
+    np.testing.assert_array_equal(st["x"], [9.0])
+    np.testing.assert_array_equal(union, [1, 1])
+    assert w == [0.0, 0.0]
+
+
+def test_merge_states_non_float_leaves_ride_first():
+    a = _item(0, {"ids": np.array([1, 2], np.int32)}, [2, 0])
+    b = _item(1, {"ids": np.array([8, 9], np.int32)}, [0, 2])
+    st, _, _ = merge_states([b, a], decay=0.9)
+    np.testing.assert_array_equal(st["ids"], [1, 2])
+
+
+# -------------------------------------------------------------- membership
+
+
+def test_membership_elastic_transitions():
+    m = MembershipView(4, self_id=1)
+    assert m.live() == (0, 1, 2, 3)
+    assert m.note_leave(3, "detector_down") is True
+    assert m.note_leave(3, "detector_down") is False  # already gone
+    assert m.live() == (0, 1, 2)
+    assert not m.is_live(3)
+    # a frame from the departed peer folds it straight back in
+    assert m.note_alive(3) is True
+    assert m.note_alive(3) is False  # steady-state attestation, no event
+    assert m.live() == (0, 1, 2, 3)
+    rep = m.report()
+    assert rep["joins"] == 1 and rep["leaves"] == 1
+
+
+def test_membership_self_never_leaves():
+    m = MembershipView(3, self_id=2)
+    assert m.note_leave(2, "detector_down") is False
+    assert m.is_live(2)
+    # out-of-range ids are ignored, not crashes (hostile header values)
+    assert m.note_alive(99) is False
+    assert m.note_leave(-1, "x") is False
+
+
+# ------------------------------------------------------------- config caps
+
+
+def _gossip_cfg(**kw):
+    dist_kw = dict(peers=3, dispatch="gossip", gossip_fanout=2)
+    dist_kw.update(kw.pop("dist_kw", {}))
+    base = dict(runtime="dist", sync="async", eval_every=0, num_clients=6,
+                dist=DistConfig(**dist_kw))
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_gossip_cfg_constructs_and_roundtrips():
+    from bcfl_tpu.dist.launch import cfg_from_json, cfg_to_json
+
+    cfg = _gossip_cfg(dist_kw=dict(gossip_topology="ring",
+                                   gossip_hello_interval_s=2.5))
+    back = cfg_from_json(cfg_to_json(cfg))
+    assert back.dist.dispatch == "gossip"
+    assert back.dist.gossip_fanout == 2
+    assert back.dist.gossip_topology == "ring"
+    assert back.dist.gossip_hello_interval_s == 2.5
+
+
+def test_gossip_robust_rules_construct_with_vote_floor():
+    # fanout 2 + self = MIN_ORDER_VOTES: the trimmed rules are honest
+    for rule in ("trimmed_mean", "median"):
+        cfg = _gossip_cfg(aggregator=rule)
+        assert cfg.dist.dispatch == "gossip"
+
+
+@pytest.mark.parametrize("kw,needle", [
+    (dict(dist_kw=dict(dispatch="mesh")), "dispatch"),
+    (dict(dist_kw=dict(gossip_topology="star")), "gossip_topology"),
+    (dict(dist_kw=dict(gossip_fanout=0)), "gossip_fanout"),
+    (dict(dist_kw=dict(gossip_fanout=3)), "< peers"),
+    (dict(dist_kw=dict(gossip_hello_interval_s=0.0)), "hello"),
+    (dict(aggregator="trimmed_mean", dist_kw=dict(gossip_fanout=1)),
+     "gossip_fanout"),
+])
+def test_gossip_bounds_rejected(kw, needle):
+    with pytest.raises(ValueError, match=needle):
+        _gossip_cfg(**kw)
+
+
+def _cap_cases():
+    from bcfl_tpu.compression import CompressionConfig
+    from bcfl_tpu.faults import FaultPlan
+
+    return {
+        "krum": dict(aggregator="krum"),
+        "partition": dict(faults=FaultPlan(
+            partition_groups=((0, 1), (2,)), partition_rounds=(1, 2))),
+        "compression": dict(compression=CompressionConfig(kind="int8")),
+    }
+
+
+@pytest.mark.parametrize("case", ["krum", "partition", "compression"])
+def test_gossip_capability_rejections(case):
+    kw = _cap_cases()[case]
+    with pytest.raises(ValueError,
+                       match="not supported on runtime='dist'"):
+        _gossip_cfg(**kw)
+    # ...and the SAME composition is fine under dispatch='leader' — the
+    # caps rows are gossip-scoped, not new blanket dist restrictions
+    # (krum additionally needs its 2f+3 leader buffer to be meaningful)
+    extra = ({"dist_kw": dict(dispatch="leader", peers=8, buffer=5),
+              "num_clients": 16} if case == "krum"
+             else {"dist_kw": dict(dispatch="leader")})
+    _gossip_cfg(**{**kw, **extra})
+
+
+# ------------------------------------------- invariant scoping and parity
+
+
+def _gev(ev, peer, seq, t, pid=None, **fields):
+    rec = {"v": 1, "ev": ev, "run": "gx", "peer": peer,
+           "pid": pid if pid is not None else 2000 + peer,
+           "seq": seq, "t_wall": t, "t_mono": t}
+    rec.update(fields)
+    return rec
+
+
+def _garrival(peer, msg_id, epoch=1, staleness=0, weight=1.0):
+    return {"peer": peer, "msg_id": msg_id, "msg_epoch": epoch,
+            "staleness": staleness, "latency_s": 0.01, "weight": weight}
+
+
+def _gmerge(peer, seq, t, version, arrivals, component=(0, 1)):
+    # the merging peer fills the "leader" slot with ITSELF — there is no
+    # other clock to name (RUNTIME.md "Gossip dispatch")
+    return _gev("gossip.merge", peer, seq, t, version=version, leader=peer,
+                arrivals=arrivals, rejected=[], solo=not arrivals,
+                degraded=False, component=list(component), wall_s=0.01)
+
+
+def _gossip_fixture():
+    """Two peers, each merging the other's update — every peer is a
+    merger, no peer is special."""
+    return [
+        _gev("send", 0, 0, 10.0, to=1, type="update", ok=True, msg_id=0,
+             msg_epoch=1, attempts=1, wall_s=0.01),
+        _gev("send", 1, 0, 10.0, to=0, type="update", ok=True, msg_id=0,
+             msg_epoch=1, attempts=1, wall_s=0.01),
+        _gev("recv", 0, 1, 10.2, src=1, msg_id=0, msg_epoch=1,
+             disposition="accepted", type="update"),
+        _gev("recv", 1, 1, 10.2, src=0, msg_id=0, msg_epoch=1,
+             disposition="accepted", type="update"),
+        _gmerge(0, 2, 11.0, version=1, arrivals=[_garrival(1, 0)]),
+        _gmerge(1, 2, 11.0, version=1, arrivals=[_garrival(0, 0)]),
+        _gev("run.end", 0, 3, 20.0, status="ok"),
+        _gev("run.end", 1, 3, 20.0, status="ok"),
+    ]
+
+
+def _stream_feed(events):
+    suite = StreamingInvariantSuite()
+    out = []
+    for e in sorted(events, key=lambda e: (e["peer"], e["seq"])):
+        out.extend(suite.feed(e))
+    for vs in suite.finalize().values():
+        out.extend(vs)
+    return out
+
+
+def test_gossip_merge_is_a_merge_event_everywhere():
+    assert "gossip.merge" in MERGE_EVS and "merge" in MERGE_EVS
+
+
+def test_gossip_fixture_clean_batch_and_streaming():
+    events = _gossip_fixture()
+    batch = run_invariants(sorted(events, key=lambda e: e["t_wall"]))
+    assert set(batch) == set(INVARIANTS)
+    assert all(not v for v in batch.values()), batch
+    assert _stream_feed(events) == []
+
+
+def test_gossip_double_merge_fires_with_parity():
+    # the SAME merger folds the same (peer, epoch, msg_id) twice
+    events = _gossip_fixture() + [
+        _gmerge(0, 4, 12.0, version=2, arrivals=[_garrival(1, 0)]),
+    ]
+    batch = run_invariants(sorted(events, key=lambda e: e["t_wall"]))
+    assert batch["no_double_merge"], "batch checker missed the re-merge"
+    live = _stream_feed(events)
+    assert any(v["rule"] == "no_double_merge" for v in live), (
+        "streaming checker missed the re-merge the batch engine caught")
+
+
+def test_gossip_cross_merger_dedup_is_per_merger():
+    # peers 0 and 1 EACH fold msg 0 from peer 2: legal — dedup identity
+    # is a per-merger fact, not a global one
+    events = [
+        _gev("send", 2, 0, 10.0, to=0, type="update", ok=True, msg_id=0,
+             msg_epoch=1, attempts=1, wall_s=0.01),
+        _gev("send", 2, 1, 10.0, to=1, type="update", ok=True, msg_id=0,
+             msg_epoch=1, attempts=1, wall_s=0.01),
+        _gev("recv", 0, 0, 10.2, src=2, msg_id=0, msg_epoch=1,
+             disposition="accepted", type="update"),
+        _gev("recv", 1, 0, 10.2, src=2, msg_id=0, msg_epoch=1,
+             disposition="accepted", type="update"),
+        _gmerge(0, 1, 11.0, version=1, arrivals=[_garrival(2, 0)],
+                component=(0, 1, 2)),
+        _gmerge(1, 1, 11.0, version=1, arrivals=[_garrival(2, 0)],
+                component=(0, 1, 2)),
+        _gev("run.end", 0, 2, 20.0, status="ok"),
+        _gev("run.end", 1, 2, 20.0, status="ok"),
+        _gev("run.end", 2, 2, 20.0, status="ok"),
+    ]
+    batch = run_invariants(sorted(events, key=lambda e: e["t_wall"]))
+    assert not batch["no_double_merge"], batch["no_double_merge"]
+    assert _stream_feed(events) == []
+
+
+# ------------------------------------------------------- loopback (3 peers)
+
+
+@pytest.mark.slow
+def test_gossip_loopback_three_peers(tmp_path):
+    """One REAL leaderless run: 3 peer processes, epidemic fan-out 2,
+    clean lanes. Every peer must carry its OWN version clock to the
+    horizon, report dispatch='gossip', keep a verifying chain, and the
+    collated streams must pass every invariant with gossip.merge events
+    actually present (non-vacuous scoping)."""
+    from bcfl_tpu.config import LedgerConfig, PartitionConfig
+    from bcfl_tpu.dist.harness import run_dist
+    from bcfl_tpu.telemetry import collate, read_stream
+
+    cfg = FedConfig(
+        name="gossip_loopback", runtime="dist", mode="server",
+        sync="async", model="tiny-bert", dataset="synthetic",
+        num_clients=6, num_rounds=3, seq_len=16, batch_size=4,
+        max_local_batches=2, eval_every=0, seed=42,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        ledger=LedgerConfig(enabled=True),
+        dist=DistConfig(peers=3, dispatch="gossip", gossip_fanout=2,
+                        buffer_timeout_s=10.0, idle_timeout_s=90.0,
+                        peer_deadline_s=150.0, suspect_after=2))
+    run_dir = str(tmp_path / "gossip_run")
+    result = run_dist(cfg, run_dir, deadline_s=170.0, platform="cpu")
+    assert result["ok"], (result["returncodes"], result["log_tails"])
+    assert result["process_count"] == 3
+    for p in range(3):
+        rep = result["reports"][p]
+        assert rep["status"] == "ok"
+        assert rep["dispatch"] == "gossip"
+        assert rep["final_version"] >= cfg.num_rounds, (
+            "a leaderless peer's own clock stalled", p, rep)
+        assert rep["chain_ok"] in (True, None)
+        vv = rep.get("vv")
+        assert vv and len(vv) == 3 and vv[p] >= cfg.num_rounds
+    col = collate(result["event_streams"])
+    assert col["ok"], col["violations"]
+    gmerges = exchanges = 0
+    for path in result["event_streams"]:
+        evs, _ = read_stream(path)
+        gmerges += sum(1 for e in evs if e["ev"] == "gossip.merge")
+        exchanges += sum(1 for e in evs if e["ev"] == "gossip.exchange")
+        assert not any(e["ev"] == "merge" for e in evs), (
+            "a leadered merge event in a gossip run")
+    assert gmerges >= 3 * cfg.num_rounds
+    assert exchanges >= 3 * cfg.num_rounds

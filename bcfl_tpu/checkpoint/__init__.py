@@ -1,5 +1,9 @@
 from bcfl_tpu.checkpoint.checkpoint import (  # noqa: F401
+    ROUND_STATUSES,
+    apply_storage_fault,
+    classify_round,
     restore_checkpoint,
     restore_latest,
     save_checkpoint,
+    scrub,
 )

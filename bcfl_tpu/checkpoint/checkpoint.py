@@ -37,6 +37,21 @@ Crash safety (ROBUSTNESS.md):
 - **Legacy tolerance.** Checkpoints written before the metadata sidecar
   existed restore as before (no digest to verify, separate
   ``ledger_XXXXXX.json`` file honored).
+- **One classification API.** :func:`classify_round` is the single reader
+  underneath :func:`restore_latest`, :func:`restore_checkpoint` and
+  :func:`scrub` — every caller sees the same damage taxonomy
+  (:data:`ROUND_STATUSES`), so the forensic view and the resume view can
+  never drift apart again (they did once: PR 10's ad-hoc
+  ``restore_checkpoint`` returned a different shape).
+- **Retention.** ``save_checkpoint(..., keep_last=K)`` garbage-collects
+  rounds beyond the newest K strictly AFTER the new round's commit+fsync,
+  so a crash mid-GC can only ever leave EXTRA old checkpoints, never zero
+  valid ones.
+- **Chaos seam.** :func:`apply_storage_fault` is the storage fault lane's
+  injection point (FaultPlan ``storage_*``, ROBUSTNESS.md §10): it damages
+  committed durable state in one of :data:`~bcfl_tpu.faults.plan.STORAGE_CLASSES`
+  deterministic ways. :func:`scrub` is the matching audit a peer runs
+  before trusting its own disk.
 """
 
 from __future__ import annotations
@@ -60,6 +75,30 @@ logger = logging.getLogger(__name__)
 # invisible to restore_latest until the atomic rename commits it
 _STAGING = ".staging."
 _META_SUFFIX = ".meta.json"
+
+# the damage taxonomy classify_round reports (ROBUSTNESS.md §10):
+#   ok              — restored, params digest verified, ledger chain verifies
+#   unverified      — restored, pre-metadata legacy layout (nothing to verify)
+#   unrestorable    — the tree itself fails to load (torn/truncated/bit rot
+#                     caught by the store)
+#   digest_mismatch — the tree loads but its params digest does not match
+#                     the committed metadata (silent payload bit rot)
+#   meta_corrupt    — a metadata sidecar EXISTS but is unreadable (the
+#                     atomic protocol never leaves this; it is damage, not
+#                     a legacy checkpoint)
+#   ledger_corrupt  — tree + digest fine but the embedded ledger chain no
+#                     longer verifies link-by-link (chain tampering)
+#   deleted         — the round dir is gone but its metadata survived (the
+#                     evidence trail outright deletion leaves behind)
+#   missing         — neither dir nor metadata (never committed, or rolled
+#                     back — rollback is locally INDISTINGUISHABLE from
+#                     "never got that far"; only the chain high-water guard
+#                     catches it)
+ROUND_STATUSES = ("ok", "unverified", "unrestorable", "digest_mismatch",
+                  "meta_corrupt", "ledger_corrupt", "deleted", "missing")
+
+# statuses a resume may trust
+_USABLE = ("ok", "unverified")
 
 
 def _to_host(tree):
@@ -94,7 +133,8 @@ def _meta_path(directory: str, round_idx: int) -> str:
 
 
 def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
-                    ledger_json: Optional[str] = None) -> str:
+                    ledger_json: Optional[str] = None,
+                    keep_last: int = 0) -> str:
     """Atomically write ``state`` (a pytree of arrays) for ``round_idx``;
     returns the committed path.
 
@@ -105,7 +145,15 @@ def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
     unverified, like a legacy checkpoint) but is NEVER paired with a
     mismatching digest — on re-save of an existing round the stale meta is
     deleted before the old tree is disturbed, so the digest check rejects
-    only genuine corruption."""
+    only genuine corruption.
+
+    ``keep_last > 0`` bounds the directory: after the NEW round is fully
+    committed and fsynced, rounds beyond the newest ``keep_last`` are
+    garbage-collected (dir + metadata + legacy ledger sidecar). The
+    ordering means a crash at any point during GC leaves extra OLD
+    checkpoints behind, never fewer than ``keep_last`` valid ones — the
+    retention knob can not create the zero-valid-checkpoint state the
+    atomic commit exists to prevent."""
     _t0 = time.perf_counter()
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
@@ -141,12 +189,68 @@ def save_checkpoint(directory: str, round_idx: int, state: Dict[str, Any],
         os.fsync(f.fileno())
     os.replace(meta_staging, meta_path)
     _fsync_dir(directory)
+    removed = []
+    if keep_last and keep_last > 0:
+        committed = _list_rounds(directory)
+        for r in committed[:-keep_last] if len(committed) > keep_last else []:
+            _remove_round(directory, r, keep_meta=False)
+            removed.append(r)
+        if removed:
+            _fsync_dir(directory)
     # one typed event per committed checkpoint (a no-op without an
     # installed writer): crash/rejoin analysis over the merged timeline
-    # needs to know which versions were durable when
+    # needs to know which versions were durable when. chain_len (rows in
+    # the committed ledger) is what the no_rollback_readmission invariant
+    # compares across process incarnations.
+    chain_len = None
+    if ledger_json:
+        try:
+            chain_len = len(json.loads(ledger_json))
+        except (ValueError, TypeError):
+            pass
     _telemetry.emit("ckpt.save", step=int(round_idx), dir=directory,
-                    wall_s=time.perf_counter() - _t0)
+                    wall_s=time.perf_counter() - _t0, chain_len=chain_len,
+                    gc=len(removed))
     return final
+
+
+def _list_rounds(directory: str) -> list:
+    """Committed round indices (dirs only), ascending."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        int(d.split("_")[1]) for d in os.listdir(directory)
+        if d.startswith("round_") and d.split("_")[1].isdigit()
+        and os.path.isdir(os.path.join(directory, d))
+    )
+
+
+def _meta_rounds(directory: str) -> list:
+    """Round indices with a metadata sidecar present, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for f in os.listdir(directory):
+        if not (f.startswith("round_") and f.endswith(_META_SUFFIX)):
+            continue
+        stem = f[:-len(_META_SUFFIX)].split("_")[1]
+        if stem.isdigit():
+            out.append(int(stem))
+    return sorted(out)
+
+
+def _remove_round(directory: str, round_idx: int, keep_meta: bool) -> None:
+    """Remove one committed round (tree + legacy ledger sidecar; metadata
+    too unless ``keep_meta``). No fsync — callers batch it."""
+    name = f"round_{round_idx:06d}"
+    path = os.path.join(directory, name)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    if not keep_meta and os.path.exists(_meta_path(directory, round_idx)):
+        os.unlink(_meta_path(directory, round_idx))
+    legacy = os.path.join(directory, f"ledger_{round_idx:06d}.json")
+    if os.path.exists(legacy):
+        os.unlink(legacy)
 
 
 def _read_meta(directory: str, round_idx: int) -> Optional[Dict[str, Any]]:
@@ -162,76 +266,282 @@ def _read_meta(directory: str, round_idx: int) -> Optional[Dict[str, Any]]:
         return None
 
 
-def restore_checkpoint(directory: str, round_idx: int
-                       ) -> Optional[Tuple[Dict[str, Any], Optional[str]]]:
-    """``(state, ledger_json)`` of ONE specific committed checkpoint, or
-    None if it is absent/unrestorable. Unlike :func:`restore_latest` this
-    does not fall back to an older round — it is the forensic read the
-    proof harnesses use to compare a specific durable state against what
-    a resumed process reports having restored (bit-identical-restore
-    gates in scripts/dist_byzantine.py)."""
+def classify_round(directory: str, round_idx: int
+                   ) -> Tuple[str, Optional[Dict[str, Any]], Optional[str]]:
+    """``(status, state, ledger_json)`` for ONE round — the single reader
+    behind :func:`restore_latest`, :func:`restore_checkpoint` and
+    :func:`scrub`. ``status`` is one of :data:`ROUND_STATUSES`; ``state``
+    and ``ledger_json`` are non-None only for the usable statuses
+    (``ok``/``unverified``)."""
     directory = os.path.abspath(directory)
-    path = os.path.join(directory, f"round_{int(round_idx):06d}")
+    round_idx = int(round_idx)
+    path = os.path.join(directory, f"round_{round_idx:06d}")
+    meta_path = _meta_path(directory, round_idx)
     if not os.path.isdir(path):
-        return None
+        return (("deleted" if os.path.exists(meta_path) else "missing"),
+                None, None)
+    meta = None
+    if os.path.exists(meta_path):
+        meta = _read_meta(directory, round_idx)
+        if meta is None:
+            # present-but-unreadable: the atomic protocol (staged write +
+            # fsync + rename) never leaves this state, so it is damage —
+            # NOT the legacy no-sidecar layout the unverified path covers
+            return "meta_corrupt", None, None
     try:
         with ocp.PyTreeCheckpointer() as ckptr:
             state = ckptr.restore(path)
     except Exception as e:  # truncated/partial tree
         logger.warning("checkpoint %s failed to restore (%s)", path, e)
-        return None
-    meta = _read_meta(directory, int(round_idx))
+        return "unrestorable", None, None
     if meta is not None and meta.get("digest"):
         if _state_digest(state) != meta["digest"]:
-            # the same integrity bar as restore_latest: ground truth that
-            # fails its own committed digest is not ground truth — a
-            # bit-identity gate comparing against it would fail (or pass)
-            # for the wrong reason
-            logger.warning("checkpoint %s params digest mismatch", path)
-            return None
-    return state, (meta.get("ledger") if meta is not None else None)
+            logger.warning("checkpoint %s params digest mismatch (bit "
+                           "corruption or foreign overwrite)", path)
+            return "digest_mismatch", None, None
+    ledger_json = meta.get("ledger") if meta is not None else None
+    if ledger_json is None:
+        # pre-metadata layout: ledger in its own sidecar file
+        legacy = os.path.join(directory, f"ledger_{round_idx:06d}.json")
+        if os.path.exists(legacy):
+            with open(legacy) as f:
+                ledger_json = f.read()
+    if ledger_json:
+        # the chain is durable state too: a checkpoint whose embedded
+        # ledger no longer verifies link-by-link must not be resumed from
+        # (a peer re-announcing a tampered chain would poison every
+        # reconcile it participates in)
+        from bcfl_tpu.ledger.ledger import Ledger
+
+        try:
+            if Ledger.from_json(ledger_json).verify_chain() != -1:
+                logger.warning("checkpoint %s ledger chain fails "
+                               "verification", path)
+                return "ledger_corrupt", None, None
+        except (ValueError, KeyError, TypeError) as e:
+            logger.warning("checkpoint %s ledger json unreadable (%s)",
+                           path, e)
+            return "ledger_corrupt", None, None
+    return ("ok" if meta is not None else "unverified"), state, ledger_json
+
+
+def restore_checkpoint(directory: str, round_idx: int
+                       ) -> Optional[Tuple[int, Dict[str, Any], Optional[str]]]:
+    """``(round, state, ledger_json)`` of ONE specific committed checkpoint
+    — the same shape :func:`restore_latest` returns — or None if it is
+    absent or damaged. Unlike ``restore_latest`` this does not fall back to
+    an older round: it is the forensic read the proof harnesses use to
+    compare a specific durable state against what a resumed process reports
+    having restored (bit-identical-restore gates in
+    scripts/dist_byzantine.py)."""
+    status, state, ledger_json = classify_round(directory, round_idx)
+    if status not in _USABLE:
+        logger.warning("checkpoint %s/round_%06d not restorable: %s",
+                       directory, int(round_idx), status)
+        return None
+    return int(round_idx), state, ledger_json
 
 
 def restore_latest(directory: str) -> Optional[Tuple[int, Dict[str, Any], Optional[str]]]:
     """(round, state, ledger_json) of the newest VALID checkpoint, or None.
 
-    Walks checkpoints newest-first; a candidate that fails to restore or
-    whose params digest mismatches its committed metadata is skipped (with
-    a warning) in favor of the next older one — a half-written or corrupted
-    newest checkpoint degrades the resume point by one interval instead of
-    killing the run."""
+    Walks checkpoints newest-first via :func:`classify_round`; a candidate
+    that fails to restore, whose params digest mismatches its committed
+    metadata, or whose embedded ledger chain fails verification is skipped
+    (with a warning) in favor of the next older one — a half-written or
+    corrupted newest checkpoint degrades the resume point by one interval
+    instead of killing the run."""
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return None
-    rounds = sorted(
-        int(d.split("_")[1]) for d in os.listdir(directory)
-        if d.startswith("round_") and d.split("_")[1].isdigit()
-        and os.path.isdir(os.path.join(directory, d))
-    )
-    for r in reversed(rounds):
-        path = os.path.join(directory, f"round_{r:06d}")
-        try:
-            with ocp.PyTreeCheckpointer() as ckptr:
-                state = ckptr.restore(path)
-        except Exception as e:  # truncated/partial tree: try the next older
-            logger.warning("checkpoint %s failed to restore (%s); falling "
-                           "back to the previous checkpoint", path, e)
-            continue
-        meta = _read_meta(directory, r)
-        if meta is not None and meta.get("digest"):
-            if _state_digest(state) != meta["digest"]:
-                logger.warning(
-                    "checkpoint %s params digest mismatch (bit corruption "
-                    "or foreign overwrite); falling back to the previous "
-                    "checkpoint", path)
-                continue
-        ledger_json = meta.get("ledger") if meta is not None else None
-        if ledger_json is None:
-            # pre-metadata layout: ledger in its own sidecar file
-            legacy = os.path.join(directory, f"ledger_{r:06d}.json")
-            if os.path.exists(legacy):
-                with open(legacy) as f:
-                    ledger_json = f.read()
-        _telemetry.emit("ckpt.restore", step=int(r), dir=directory)
-        return r, state, ledger_json
+    for r in reversed(_list_rounds(directory)):
+        status, state, ledger_json = classify_round(directory, r)
+        if status in _USABLE:
+            _telemetry.emit("ckpt.restore", step=int(r), dir=directory)
+            return r, state, ledger_json
+        logger.warning("checkpoint %s/round_%06d %s; falling back to the "
+                       "previous checkpoint", directory, r, status)
     return None
+
+
+def scrub(directory: str) -> Dict[str, Any]:
+    """Audit EVERY round of a peer's durable state before trusting it —
+    the startup half of the storage fault lane (ROBUSTNESS.md §10).
+
+    Returns::
+
+        {"empty":         no committed rounds, no metadata, no staging,
+         "rounds":        ((round, status), ...) ascending, the union of
+                          dir-listed and metadata-listed rounds,
+         "newest_intact": newest usable round index or None,
+         "damaged":       ((round, status), ...) for non-usable statuses,
+         "torn":          (staging entry names, ...) — interrupted commits
+                          left on disk}
+
+    and emits one ``scrub`` telemetry event summarising the verdict
+    (``clean`` / ``damaged`` / ``empty``). Note what scrub can NOT see:
+    a clean rollback (newest rounds removed dir+meta) classifies as
+    ``missing``/absent — locally indistinguishable from "never got that
+    far". That detection belongs to the chain high-water guard in the
+    dist runtime, which is why ``no_rollback_readmission`` is an
+    invariant over the merged timeline rather than a scrub status."""
+    directory = os.path.abspath(directory)
+    torn = tuple(sorted(
+        d for d in (os.listdir(directory) if os.path.isdir(directory) else ())
+        if d.startswith(_STAGING)))
+    rounds = sorted(set(_list_rounds(directory)) | set(_meta_rounds(directory)))
+    statuses = tuple((r, classify_round(directory, r)[0]) for r in rounds)
+    damaged = tuple((r, s) for r, s in statuses if s not in _USABLE)
+    usable = [r for r, s in statuses if s in _USABLE]
+    report = {
+        "empty": not statuses and not torn,
+        "rounds": statuses,
+        "newest_intact": max(usable) if usable else None,
+        "damaged": damaged,
+        "torn": torn,
+    }
+    verdict = ("empty" if report["empty"]
+               else "damaged" if (damaged or torn) else "clean")
+    _telemetry.emit("scrub", status=verdict, dir=directory,
+                    newest_intact=report["newest_intact"],
+                    damaged=len(damaged), torn=len(torn))
+    return report
+
+
+_HEX = "0123456789abcdef"
+
+
+def _rot_hex(ch: str) -> str:
+    """A DIFFERENT hex digit, deterministically (bit rot that always
+    changes the value)."""
+    return _HEX[(_HEX.index(ch.lower()) + 1) % 16]
+
+
+def _tree_files(path: str) -> list:
+    """Every file under a committed round dir, largest first (name-ordered
+    within a size tie) — the deterministic target order the flip/truncate
+    damage classes index into."""
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            p = os.path.join(root, fn)
+            out.append((-os.path.getsize(p), os.path.relpath(p, path), p))
+    return [p for _sz, _rel, p in sorted(out)]
+
+
+def apply_storage_fault(directory: str, action: Dict[str, Any]
+                        ) -> Optional[Dict[str, Any]]:
+    """Damage committed durable state per one FaultPlan storage draw
+    (``FaultPlan.storage_action``) — the injection half of the storage
+    fault lane. ``action`` is ``{"cls", "frac", "delete_last"}``; the
+    damage targets the NEWEST committed round (plus older ones for
+    delete/rollback). Returns a record of what was done (for the ``chaos``
+    telemetry event) or None when there was nothing to damage — the lane
+    models media failure of state that EXISTS, never a failure to write.
+
+    Class semantics (see STORAGE_CLASSES in bcfl_tpu.faults.plan):
+    ``delete`` removes round dirs but LEAVES the metadata sidecars — the
+    evidence trail real deletion tends to leave; ``rollback`` removes the
+    newest round dir AND metadata cleanly, leaving an older intact
+    snapshot as the apparent newest — locally undetectable by design."""
+    directory = os.path.abspath(directory)
+    rounds = _list_rounds(directory)
+    if not rounds:
+        return None
+    cls = action["cls"]
+    frac = float(action.get("frac", 0.0))
+    newest = rounds[-1]
+    name = f"round_{newest:06d}"
+    path = os.path.join(directory, name)
+    meta_path = _meta_path(directory, newest)
+    record: Dict[str, Any] = {"cls": cls, "round": int(newest)}
+
+    if cls == "torn":
+        # re-create the interrupted-commit state: tree back under a
+        # scan-invisible staging name, no committed dir, no metadata
+        staging = os.path.join(directory, _STAGING + name)
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        if os.path.exists(meta_path):
+            os.unlink(meta_path)
+        os.replace(path, staging)
+    elif cls in ("payload_flip", "truncate"):
+        files = [f for f in _tree_files(path) if os.path.getsize(f) > 0]
+        if not files:
+            return None
+        target = files[0]
+        size = os.path.getsize(target)
+        offset = min(int(frac * size), size - 1)
+        record["file"] = os.path.relpath(target, directory)
+        record["offset"] = offset
+        if cls == "payload_flip":
+            with open(target, "r+b") as f:
+                f.seek(offset)
+                b = f.read(1)
+                f.seek(offset)
+                f.write(bytes([b[0] ^ 0xFF]))
+        else:
+            with open(target, "r+b") as f:
+                f.truncate(offset)
+    elif cls == "meta_flip":
+        # target the newest round that HAS a sidecar — the newest dir may
+        # transiently lack one (kill landed inside the commit window)
+        metas = _meta_rounds(directory)
+        if not metas:
+            return None
+        record["round"] = int(metas[-1])
+        meta_path = _meta_path(directory, metas[-1])
+        # rot one hex digit of the committed params digest: the smallest
+        # metadata bit flip that is GUARANTEED detectable (a flip landing
+        # in json whitespace would be a silent no-op the soak's
+        # every-class-fired gate could not count)
+        with open(meta_path, "rb") as f:
+            raw = bytearray(f.read())
+        tag = b'"digest": "'
+        idx = raw.find(tag)
+        if idx < 0:
+            return None
+        pos = idx + len(tag) + min(int(frac * 64), 63)
+        raw[pos] = ord(_rot_hex(chr(raw[pos])))
+        record["offset"] = pos
+        with open(meta_path, "wb") as f:
+            f.write(raw)
+    elif cls == "ledger":
+        metas = _meta_rounds(directory)
+        if not metas:
+            return None
+        newest = metas[-1]
+        record["round"] = int(newest)
+        meta_path = _meta_path(directory, newest)
+        meta = _read_meta(directory, newest)
+        if not meta or not meta.get("ledger"):
+            return None
+        try:
+            rows = json.loads(meta["ledger"])
+        except (ValueError, TypeError):
+            return None
+        if not rows:
+            return None
+        row = rows[min(int(frac * len(rows)), len(rows) - 1)]
+        row["head"] = _rot_hex(row["head"][0]) + row["head"][1:]
+        meta["ledger"] = json.dumps(rows)
+        record["row"] = min(int(frac * len(rows)), len(rows) - 1)
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+    elif cls == "delete":
+        k = max(1, int(action.get("delete_last", 1)))
+        victims = rounds[-k:]
+        for r in victims:
+            # keep_meta: deletion leaves the sidecars — the evidence scrub
+            # classifies as "deleted" (vs rollback, which sweeps both)
+            p = os.path.join(directory, f"round_{r:06d}")
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+        record["rounds"] = [int(r) for r in victims]
+    elif cls == "rollback":
+        _remove_round(directory, newest, keep_meta=False)
+        record["now_newest"] = int(rounds[-2]) if len(rounds) > 1 else None
+    else:
+        raise ValueError(f"unknown storage damage class {cls!r}")
+    _fsync_dir(directory)
+    return record

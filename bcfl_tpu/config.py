@@ -130,6 +130,11 @@ class DistConfig:
     # checkpoint every N adopted/produced global versions (0 = off); the
     # crash/rejoin path restores from the newest one
     checkpoint_every_versions: int = 1
+    # checkpoint retention: keep only the newest K committed rounds on
+    # disk (0 = keep everything). Removal of older rounds is ordered
+    # strictly AFTER the new round's commit+fsync, so a crash mid-GC can
+    # only ever leave EXTRA rounds, never fewer than K usable ones.
+    checkpoint_keep_last: int = 0
     # --- self-healing transport policy (RUNTIME.md "Delivery contract") ---
     # every logical send retries failed attempts with exponential backoff
     # (base * 2^k, capped at retry_max_s, deterministically jittered) up to
@@ -241,6 +246,10 @@ class DistConfig:
             raise ValueError(
                 f"report_every_rounds must be >= 0, got "
                 f"{self.report_every_rounds}")
+        if self.checkpoint_keep_last < 0:
+            raise ValueError(
+                f"checkpoint_keep_last must be >= 0 (0 keeps all), got "
+                f"{self.checkpoint_keep_last}")
         if not 0.0 < self.quorum_frac <= 1.0:
             raise ValueError(
                 f"quorum_frac must be in (0, 1], got {self.quorum_frac}")
@@ -426,6 +435,17 @@ RUNTIME_CAPS: Tuple = (
       "dist": "kill the peer PROCESS instead (scripts/dist_async.py "
               "--kill-peer): a real crash is the thing itself, not a "
               "simulated one"}),
+    ("chaos: storage faults",
+     lambda c: c.faults.storage_enabled,
+     {"local": "the storage lane damages a peer's durable checkpoint/"
+               "ledger state at the post-commit seam and exercises the "
+               "scrub + STATE_SYNC repair path; the local engine has no "
+               "per-peer durable state or peers to repair from — dist "
+               "only",
+      "dist": True}),  # injected in _maybe_checkpoint after commit+fsync
+    # (faults/plan.py lane 8); detection is the startup scrub +
+    # restore-time classification, recovery is the ledger-authenticated
+    # STATE_SYNC transfer (ROBUSTNESS.md §10)
     # --- gossip-dispatch composition rows (RUNTIME.md "Gossip dispatch"):
     # active only when the dist runtime is asked for dispatch='gossip', so
     # they never fire for local runs or the leadered dist path ---
@@ -767,6 +787,33 @@ class FedConfig:
                         "byz_peers lists EVERY peer: an all-adversarial "
                         "federation has no honest majority for any rule "
                         "to defend — leave at least one peer honest")
+            if self.faults.storage_enabled:
+                if self.faults.storage_peers:
+                    bad = [p for p in self.faults.storage_peers
+                           if p >= self.dist.peers]
+                    if bad:
+                        raise ValueError(
+                            f"storage_peers name PEERS; ids {bad} are >= "
+                            f"peers={self.dist.peers}")
+                for srv, req in (self.faults.sync_tamper or ()):
+                    if srv >= self.dist.peers or req >= self.dist.peers:
+                        raise ValueError(
+                            f"sync_tamper pair ({srv}, {req}) names PEERS; "
+                            f"ids must be < peers={self.dist.peers}")
+                if not self.dist.checkpoint_every_versions:
+                    raise ValueError(
+                        "the storage fault lane injects at the checkpoint "
+                        "commit seam; checkpoint_every_versions=0 never "
+                        "writes one, so the lane would silently never "
+                        "fire — enable checkpointing or drop the lane")
+                if not self.ledger.enabled:
+                    raise ValueError(
+                        "the storage lane's repair path authenticates "
+                        "STATE_SYNC transfers against the hash chain "
+                        "(commitment rows + verify_segment); without "
+                        "ledger.enabled there is no root of trust to "
+                        "verify a transfer against — enable the ledger "
+                        "or drop the lane")
             if self.aggregator != "mean":
                 # robust aggregators are supported on dist WITH declared
                 # preconditions on the merge buffer (RUNTIME.md §5): the

@@ -182,12 +182,13 @@ class GossipPeerRuntime(PeerRuntime):
     DRAIN_S = 2.0
 
     def __init__(self, cfg, peer_id: int, ports: List[int], run_dir: str,
-                 resume: bool = False):
+                 resume: bool = False, bootstrap: bool = False):
         # _restore (called inside super().__init__ when resume=True) runs
         # the _restore_extra hook before any subclass attribute exists —
         # pre-seed the one slot it writes
         self._gossip_restored_vv = None
-        super().__init__(cfg, peer_id, ports, run_dir, resume=resume)
+        super().__init__(cfg, peer_id, ports, run_dir, resume=resume,
+                         bootstrap=bootstrap)
         self.membership = MembershipView(self.peers, self.peer_id)
         # per-source version vector: vv[p] = local training rounds of peer
         # p this state has incorporated (directly or transitively)
@@ -216,6 +217,31 @@ class GossipPeerRuntime(PeerRuntime):
         if state.get("gossip_vv") is not None:
             self._gossip_restored_vv = np.asarray(state["gossip_vv"],
                                                   np.int64)
+
+    def _sync_targets(self) -> List[int]:
+        """Gossip's membership join path: each STATE_SYNC attempt asks ONE
+        peer drawn seeded from the LIVE view (hello lane, keyed by the
+        attempt counter) — same replayable topology discipline as the
+        beacon, no leader to prefer."""
+        mem = getattr(self, "membership", None)
+        live = (mem.live() if mem is not None
+                else tuple(range(self.peers)))
+        return list(sample_neighbors(self.cfg.seed, self._sync_target_i,
+                                     self.peer_id, live, 1, "epidemic",
+                                     lane=HELLO_LANE))
+
+    def _sync_serve_extra(self, header_out: Dict) -> None:
+        # the served state incorporates this peer's training frontier —
+        # ship the vv so the adopter's staleness lag starts truthful
+        header_out["vv"] = [int(x) for x in self.vv]
+
+    def _adopt_extra(self, header: Dict, trees: Dict) -> None:
+        import jax
+
+        self._state_np = jax.tree.map(np.asarray, trees["model"])
+        vv = header.get("vv")
+        if vv is not None and len(vv) == self.peers:
+            self.vv = np.maximum(self.vv, np.asarray(vv, np.int64))
 
     def _report_extra(self) -> Dict:
         # the deadline Timer can fire between super().__init__ and the
@@ -582,6 +608,8 @@ class GossipPeerRuntime(PeerRuntime):
     def _handle_gossip_hello(self, header: Dict):
         """ANY peer answers a hello (no leader gate): reply with the full
         current state, vv, and chain — the sync a joiner folds in."""
+        if self._needs_bootstrap:
+            return  # nothing trustworthy to serve while damaged
         src = int(header["from"])
         if self._state_np is None:
             import jax
@@ -605,6 +633,11 @@ class GossipPeerRuntime(PeerRuntime):
         state as a normal arrival for the next merge."""
         from bcfl_tpu.ledger import Ledger
 
+        if self._needs_bootstrap:
+            # a damaged peer adopts state ONLY through the verified
+            # STATE_SYNC gates (commitment row + refingerprint) — the
+            # hello-sync fold has no state commitment to check against
+            return
         src = int(header.get("from", -1))
         rows = header.get("chain")
         if rows and self.chain is not None:
@@ -662,6 +695,10 @@ class GossipPeerRuntime(PeerRuntime):
             self._handle_gossip_hello(header)
         elif kind == "sync":
             self._handle_sync(header, trees)
+        elif kind == "state_sync_req":
+            self._handle_state_sync_req(header)
+        elif kind == "state_sync":
+            self._handle_state_sync(header, trees)
         elif kind == "leaving":
             self._peers_done.add(src)
             self.membership.note_leave(src, "leaving")
@@ -728,7 +765,7 @@ class GossipPeerRuntime(PeerRuntime):
                 name=f"bcfl-gossip-intake-{self.peer_id}")
             self._intake_thread.start()
         self._write_report(status="running")
-        if self._resumed:
+        if self._resumed and not self._needs_bootstrap:
             # a rejoiner's first beacon is immediate: it re-enters every
             # live view it touches and gets a sync back
             self._last_hello_beacon = 0.0
@@ -743,6 +780,12 @@ class GossipPeerRuntime(PeerRuntime):
                     msg = self._next_ctrl(timeout_s=0.0)
                 if self._stop:
                     break
+                if self._needs_bootstrap:
+                    # damaged/empty durable state: neither train, beacon,
+                    # nor serve until a verified STATE_SYNC is adopted
+                    self._maybe_request_sync()
+                    time.sleep(0.05)
+                    continue
                 self._maybe_hello()
                 if self.version < self.cfg.num_rounds:
                     # train, then merge whatever arrived meanwhile: the

@@ -75,7 +75,8 @@ def _peer_env(platform: Optional[str]) -> Dict[str, str]:
 
 
 def spawn_peer(cfg_path: str, peer_id: int, ports: List[int], run_dir: str,
-               resume: bool = False, platform: Optional[str] = None,
+               resume: bool = False, bootstrap: bool = False,
+               platform: Optional[str] = None,
                repo_root: Optional[str] = None) -> subprocess.Popen:
     log_path = os.path.join(run_dir, f"peer{peer_id}.log")
     cmd = [sys.executable, "-m", "bcfl_tpu.dist",
@@ -84,6 +85,8 @@ def spawn_peer(cfg_path: str, peer_id: int, ports: List[int], run_dir: str,
            "--run-dir", run_dir]
     if resume:
         cmd.append("--resume")
+    if bootstrap:
+        cmd.append("--bootstrap")
     if platform:
         cmd.extend(["--platform", platform])
     log = open(log_path, "ab")
@@ -127,6 +130,16 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
     the leader finalizes, or the orphan re-joins a dead mesh), the peer
     is SIGKILLed, left down ``downtime_s``, and restarted with
     ``--resume``. Cycle records land under ``result["churn"]``.
+
+    Two optional churn keys drive the storage-chaos variant
+    (scripts/dist_soak.py --storage, ROBUSTNESS.md §10): ``"damage"`` —
+    a list of damage class names (checkpoint.STORAGE_CLASSES) applied to
+    the downed peer's checkpoint directory WHILE IT IS DOWN, cycled one
+    class per kill (supervisor-side injection: deterministic coverage of
+    every listed class, complementing the in-process seeded lane 8) —
+    and ``"bootstrap"`` — restart the peer with ``--resume --bootstrap``
+    so a scrub that finds nothing usable repairs over STATE_SYNC instead
+    of exiting with ResumeError.EXIT_CODE.
 
     Returns ``{"ok", "returncodes", "reports", "run_dir", ...}``; raises
     nothing on peer failure — the caller inspects the result (and the logs
@@ -193,8 +206,14 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
             else:
                 # checkpoint guard: only kill a peer that can resume
                 ckdir = os.path.join(run_dir, f"ckpt_peer{cp}")
+                # a round is only fair game once FULLY committed (tree dir
+                # AND meta sidecar) — killing inside the commit window
+                # would leave the damage lane nothing to damage
                 if os.path.isdir(ckdir) and any(
                         name.startswith("round_")
+                        and name.endswith(".meta.json")
+                        and os.path.isdir(os.path.join(
+                            ckdir, name[:-len(".meta.json")]))
                         for name in os.listdir(ckdir)):
                     proc = procs[cp]
                     proc.send_signal(signal.SIGKILL)
@@ -202,12 +221,32 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
                     _LIVE.discard(proc)
                     getattr(proc, "_bcfl_log", None) \
                         and proc._bcfl_log.close()
+                    damage = None
+                    classes = churn.get("damage")
+                    if classes:
+                        # storage-chaos churn: damage the corpse's durable
+                        # state while it is down, one class per cycle in
+                        # list order (deterministic coverage of every
+                        # listed class across the soak)
+                        from bcfl_tpu.checkpoint import apply_storage_fault
+                        cls = classes[len(churn_records) % len(classes)]
+                        frac = round(
+                            ((len(churn_records) + 1) * 0.31) % 1.0, 3)
+                        try:
+                            damage = apply_storage_fault(
+                                ckdir, {"cls": cls, "frac": frac,
+                                        "delete_last": 1})
+                        except (OSError, ValueError) as e:
+                            damage = {"cls": cls, "error": str(e)}
                     time.sleep(float(churn.get("downtime_s", 2.0)))
-                    procs[cp] = spawn_peer(cfg_path, cp, ports, run_dir,
-                                           resume=True, platform=platform)
+                    procs[cp] = spawn_peer(
+                        cfg_path, cp, ports, run_dir, resume=True,
+                        bootstrap=bool(churn.get("bootstrap")),
+                        platform=platform)
                     churn_records.append(
                         {"peer": cp, "cycle": len(churn_records) + 1,
-                         "killed_at_s": round(time.time() - t0, 3)})
+                         "killed_at_s": round(time.time() - t0, 3),
+                         **({"damage": damage} if damage else {})})
                     churn_next = (time.time()
                                   + float(churn.get("period_s", 45.0)))
         if all(rc is not None for rc in rcs.values()):

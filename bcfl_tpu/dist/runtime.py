@@ -68,6 +68,17 @@ from bcfl_tpu import telemetry
 logger = logging.getLogger(__name__)
 
 
+class ResumeError(RuntimeError):
+    """``--resume`` found no usable durable state and ``--bootstrap`` was
+    not given. Distinct exit code so supervisors distinguish "my state is
+    gone" (operator decision needed: accept peer repair or investigate)
+    from every crash/stall/deadline failure mode — a peer must never
+    silently re-enter the fleet with zero state (RUNTIME.md "State-sync
+    protocol")."""
+
+    EXIT_CODE = 8
+
+
 @dataclasses.dataclass
 class MergeRecord:
     version: int
@@ -80,6 +91,26 @@ class MergeRecord:
     quorum: Optional[Dict] = None  # {"component", "alive", "down"} when degraded
     robust: Optional[Dict] = None  # robust-rule info (k, trim_t/krum_*) when armed
     robust_degraded: bool = False  # fewer arrivals than the declared precondition
+
+
+def _tamper_tree(tree, frac: float):
+    """Flip one byte of one leaf (both chosen by ``frac``) — the seeded
+    in-flight corruption of a served STATE_SYNC transfer
+    (``FaultPlan.sync_tamper``). Deterministic pure function of the input
+    draw; the original tree is not mutated."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = min(int(frac * len(leaves)), len(leaves) - 1)
+    arr = np.asarray(leaves[idx])
+    raw = bytearray(arr.tobytes())
+    if raw:
+        pos = min(int(frac * len(raw)), len(raw) - 1)
+        raw[pos] ^= 0xFF
+    leaves = list(leaves)
+    leaves[idx] = np.frombuffer(bytes(raw),
+                                arr.dtype).reshape(arr.shape).copy()
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def measured_staleness(leader_version: int, base_version: int):
@@ -126,7 +157,7 @@ def _peer_engine_cfg(cfg, local_clients: int):
 
 class PeerRuntime:
     def __init__(self, cfg, peer_id: int, ports: List[int], run_dir: str,
-                 resume: bool = False):
+                 resume: bool = False, bootstrap: bool = False):
         import jax
 
         from bcfl_tpu.dist.transport import (
@@ -219,6 +250,18 @@ class PeerRuntime:
         self._last_reconcile_try = 0.0
         self._stop = False
         self._resumed = False
+        # --- durable-state repair (RUNTIME.md "State-sync protocol") ---
+        # set by _restore when the scrub finds nothing usable (--bootstrap)
+        # or the monotone-incarnation guard detects a rollback; while set,
+        # the peer neither trains nor announces — it requests STATE_SYNC
+        # from live peers until a verified transfer is adopted
+        self.bootstrap = bool(bootstrap)
+        self._needs_bootstrap = False
+        self._bootstrap_reason: Optional[str] = None
+        self._repaired: Optional[Dict] = None
+        self._last_sync_req = 0.0
+        self._sync_target_i = 0
+        self._sync_serves: Dict[int, int] = {}  # requester -> serves so far
 
         # per-PEER reputation (reputation/dist.py): wire evidence ->
         # quarantine, transitions committed to the chain, state
@@ -286,6 +329,13 @@ class PeerRuntime:
             chaos=chaos, policy=cfg.dist, epoch=epoch)
 
         self.ckpt_dir = os.path.join(run_dir, f"ckpt_peer{self.peer_id}")
+        # monotone-incarnation high-water marker: like the transport epoch
+        # file, a tiny supervisor-domain record OUTSIDE the checkpoint dir
+        # — the newest (version, chain_len) this peer ever made durable.
+        # A restore landing BELOW it means the durable state was rolled
+        # back (or fell back past damage) and must resync forward before
+        # announcing anything (see _restore).
+        self._hw_path = os.path.join(run_dir, f"highwater_peer{self.peer_id}")
         if resume:
             self._restore()
 
@@ -397,6 +447,14 @@ class PeerRuntime:
     def _report_extra(self) -> Dict:
         """Extra keys a dispatch subclass folds into the peer report."""
         return {}
+
+    def _sync_serve_extra(self, header_out: Dict) -> None:
+        """Extra header keys a dispatch subclass ships with a STATE_SYNC
+        serve (gossip adds its version vector)."""
+
+    def _adopt_extra(self, header: Dict, trees: Dict) -> None:
+        """Dispatch-subclass hook after a verified STATE_SYNC adoption
+        (gossip refreshes its host state copy and version vector)."""
 
     def _cast(self, tree):
         import jax.numpy as jnp
@@ -1110,6 +1168,11 @@ class PeerRuntime:
         from bcfl_tpu.ledger import Ledger
 
         version = int(header["version"])
+        if self._needs_bootstrap:
+            # repair in flight: globals are not commitment-refingerprinted,
+            # so a bootstrapping peer adopts ONLY through the verified
+            # STATE_SYNC path (repair_authenticated invariant)
+            return
         if version <= self.version:
             return
         if self._pending_reconcile and not header.get("healed"):
@@ -1206,6 +1269,182 @@ class PeerRuntime:
         # next throttled HELLO
         self.transport.send(src, reply, {"model": model})
 
+    # ------------------------------------- state-sync repair (RUNTIME.md)
+
+    def _sync_targets(self) -> List[int]:
+        """Peers a bootstrap request cycles through: the leader first (it
+        holds the authoritative state in leadered dispatch), then every
+        other peer — any live peer can serve, so a damaged LEADER repairs
+        from its followers. Gossip overrides this with a seeded neighbor
+        sample."""
+        leader = min(p for p in range(self.peers) if p != self.peer_id)
+        rest = [p for p in range(self.peers)
+                if p not in (self.peer_id, leader)]
+        return [leader] + rest
+
+    def _maybe_request_sync(self):
+        """Throttled STATE_SYNC request loop: while ``_needs_bootstrap``,
+        ask one live peer (cycling) for its full verified state. Runs from
+        the main loop — the peer neither trains nor announces until a
+        transfer is adopted."""
+        if not self._needs_bootstrap:
+            return
+        if time.time() - self._last_sync_req < 2.0:
+            return
+        self._last_sync_req = time.time()
+        targets = self._sync_targets()
+        if not targets:
+            return
+        dst = targets[self._sync_target_i % len(targets)]
+        self._sync_target_i += 1
+        telemetry.emit("state.sync.request",
+                       reason=self._bootstrap_reason or "empty",
+                       to=int(dst), have_version=int(self.version),
+                       have_len=(len(self.chain)
+                                 if self.chain is not None else 0))
+        self.transport.send(dst, {
+            "type": "state_sync_req",
+            "reason": self._bootstrap_reason or "empty",
+            "version": int(self.version),
+            "have_len": int(len(self.chain)) if self.chain is not None else 0,
+        })
+
+    def _handle_state_sync_req(self, header: Dict):
+        """Serve a damaged/empty peer the full current state, anchored to
+        the chain: a reserved commitment row (``Ledger.commit_state``)
+        binding ``params_digest(state)`` at the current version is
+        appended (once per distinct digest) BEFORE the transfer, so the
+        receiver can verify the chain segment link-by-link and then
+        refingerprint the tree against committed history — the transfer
+        is trustless even though the server is just a peer."""
+        import jax
+
+        if self._needs_bootstrap:
+            return  # damaged myself: the requester's cycle finds another
+        from bcfl_tpu.ledger.ledger import Ledger, params_digest
+
+        src = int(header["from"])
+        model = jax.tree.map(np.asarray, jax.device_get(self.trainable))
+        header_out = {"type": "state_sync", "version": int(self.version)}
+        if self.chain is not None:
+            digest = params_digest(model, self.cfg.ledger.use_native)
+            rows = self.chain.segment(0)
+            if Ledger.find_state_commitment(
+                    rows, self.version, self.peer_id) != digest:
+                self.chain.commit_state(self.version, self.peer_id, digest)
+                telemetry.emit("ledger", op="commit_state",
+                               chain_len=len(self.chain), rewrite=False,
+                               head8=self.chain.head.hex()[:16])
+            header_out["chain"] = self.chain.segment(0)
+        else:
+            header_out["chain"] = None
+        self._sync_serve_extra(header_out)
+        serial = self._sync_serves.get(src, 0)
+        self._sync_serves[src] = serial + 1
+        tam = self.cfg.faults.sync_tamper_action(self.peer_id, src, serial)
+        if tam is not None:
+            # seeded in-flight tamper (AFTER the digest was committed):
+            # the refusal this provokes at the receiver is the proof the
+            # refingerprint gate is load-bearing
+            model = _tamper_tree(model, tam["frac"])
+        telemetry.emit("state.sync.serve", to=src,
+                       version=int(self.version),
+                       chain_len=(len(self.chain)
+                                  if self.chain is not None else 0),
+                       tampered=tam is not None, serial=serial)
+        self.transport.send(src, header_out, {"model": model})
+
+    def _handle_state_sync(self, header: Dict, trees: Dict):
+        """Adopt a served state — but only after BOTH verification gates
+        pass: (1) the chain segment verifies link-by-link from genesis AND
+        extends this peer's surviving prefix (a tampered row or a forked
+        history fails here, via the existing verify_segment/fork_point
+        API); (2) the received tree refingerprints to the state commitment
+        row the chain carries for exactly this (version, server). Refusals
+        re-enter the request cycle; nothing is adopted on faith.
+
+        A serve landing AFTER a completed repair (the requester cycled
+        targets and another peer answered first) is still pushed through
+        the same gates so the evidence is durable — a tampered late
+        transfer must surface as a state.sync.refuse, not vanish into
+        the duplicate drop — but is never adopted, and a refused late
+        serve does not re-enter the request cycle."""
+        from bcfl_tpu.ledger.ledger import GENESIS, Ledger, params_digest
+
+        adopting = self._needs_bootstrap
+        server = int(header["from"])
+        version = int(header["version"])
+        rows = header.get("chain")
+        refuse = None
+        digest = recomputed = None
+        if self.chain is not None:
+            if not rows:
+                refuse = "no_chain"
+            elif Ledger.verify_segment(
+                    GENESIS, rows, self.cfg.ledger.use_native) != -1:
+                refuse = "bad_links"
+            else:
+                heads = [bytes.fromhex(r["head"]) for r in rows]
+                if self.chain.fork_point(heads) < len(self.chain):
+                    # the served history contradicts what this peer still
+                    # durably holds — a fork (or a rolled-back server);
+                    # never adopt a chain that rewrites a surviving prefix
+                    refuse = "forked_prefix"
+                else:
+                    digest = Ledger.find_state_commitment(rows, version,
+                                                          server)
+                    if digest is None:
+                        refuse = "no_commitment"
+                    else:
+                        recomputed = params_digest(
+                            trees["model"], self.cfg.ledger.use_native)
+                        if recomputed != digest:
+                            refuse = "digest_mismatch"
+        telemetry.emit("state.sync.verify", ok=refuse is None,
+                       src=server, version=version,
+                       digest8=(recomputed.hex()[:16]
+                                if recomputed is not None else None),
+                       reason=refuse)
+        if refuse is not None:
+            logger.warning("peer %d: refusing state_sync from %d (%s)",
+                           self.peer_id, server, refuse)
+            telemetry.emit("state.sync.refuse", reason=refuse, src=server,
+                           version=version)
+            if adopting:
+                # re-request immediately from the next target in the cycle
+                self._last_sync_req = 0.0
+            return
+        if not adopting:
+            return  # clean late serve: audited above, nothing to adopt
+        if self.chain is not None:
+            replica = Ledger(self.cfg.ledger.use_native)
+            replica.append_rows(rows)  # verified above; rebuild the heads
+            self.chain = replica
+            self.eng.ledger = replica
+            telemetry.emit("ledger", op="resync", chain_len=len(self.chain),
+                           rewrite=True, head8=self.chain.head.hex()[:16])
+            if self.rep is not None:
+                self.rep.absorb_rows(rows)
+        self.trainable = self.eng.mesh.replicate(self._cast(trees["model"]))
+        self.version = version
+        self.adopted.append(version)
+        self._note_version()
+        self._adopt_extra(header, trees)
+        self._needs_bootstrap = False
+        reason = self._bootstrap_reason
+        self._bootstrap_reason = None
+        self._repaired = {"from": server, "version": version,
+                          "reason": reason}
+        telemetry.emit("state.sync.adopt", version=version, src=server,
+                       digest8=(digest.hex()[:16]
+                                if digest is not None else None),
+                       chain_len=(len(self.chain)
+                                  if self.chain is not None else 0),
+                       reason=reason)
+        logger.info("peer %d: repaired from peer %d at version %d (%s)",
+                    self.peer_id, server, version, reason)
+        self._maybe_checkpoint()
+
     # --------------------------------------------------- checkpoint / resume
 
     def _maybe_checkpoint(self):
@@ -1237,17 +1476,70 @@ class PeerRuntime:
         state.update(self._checkpoint_extra())
         save_checkpoint(self.ckpt_dir, self.version, state,
                         self.chain.to_json()
-                        if self.chain is not None else None)
+                        if self.chain is not None else None,
+                        keep_last=cfg.dist.checkpoint_keep_last)
+        self._write_highwater()
+        # storage fault lane (ROBUSTNESS.md §10): damage the committed
+        # durable state per the seeded (peer, version) draw — injected
+        # AFTER the commit, the media-failure model
+        action = cfg.faults.storage_action(self.version, self.peer_id)
+        if action is not None:
+            from bcfl_tpu.checkpoint import apply_storage_fault
+
+            record = apply_storage_fault(self.ckpt_dir, action)
+            if record is not None:
+                telemetry.emit("chaos", lane="storage", action=record["cls"],
+                               version=int(self.version), **{
+                                   k: v for k, v in record.items()
+                                   if k != "cls"})
+
+    # ------------------------------------------- durable high-water marker
+
+    def _read_highwater(self) -> Optional[Dict]:
+        try:
+            with open(self._hw_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_highwater(self):
+        hw = self._read_highwater()
+        cur = {"version": int(self.version),
+               "chain_len": len(self.chain) if self.chain is not None else 0}
+        if hw is not None and hw.get("version", -1) >= cur["version"]:
+            return
+        tmp = self._hw_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cur, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._hw_path)
 
     def _restore(self):
-        from bcfl_tpu.checkpoint import restore_latest
+        from bcfl_tpu.checkpoint import restore_latest, scrub
         from bcfl_tpu.compression import codecs as cc
         from bcfl_tpu.ledger import Ledger
 
+        report = scrub(self.ckpt_dir)
         restored = restore_latest(self.ckpt_dir)
         if restored is None:
-            logger.warning("peer %d: --resume with no checkpoint; starting "
-                           "fresh", self.peer_id)
+            if not self.bootstrap:
+                # loud by default: a --resume peer whose durable state is
+                # gone or wholly damaged must not silently rejoin with
+                # zero state — that is an operator decision (--bootstrap)
+                raise ResumeError(
+                    f"peer {self.peer_id}: --resume found no usable "
+                    f"checkpoint in {self.ckpt_dir} "
+                    f"(scrub: {'empty' if report['empty'] else 'damaged'}, "
+                    f"damaged={list(report['damaged'])}, "
+                    f"torn={list(report['torn'])}); pass --bootstrap to "
+                    f"opt into ledger-authenticated peer repair")
+            self._needs_bootstrap = True
+            self._bootstrap_reason = ("empty" if report["empty"]
+                                      else "damaged")
+            logger.warning("peer %d: no usable checkpoint (%s); will "
+                           "bootstrap from a live peer", self.peer_id,
+                           self._bootstrap_reason)
             return
         _, state, ledger_json = restored
         ck_seed = state.get("seed")
@@ -1301,6 +1593,22 @@ class PeerRuntime:
         logger.info("peer %d: restored checkpoint at version %d "
                     "(round %d)", self.peer_id, self.version,
                     self.local_round)
+        hw = self._read_highwater()
+        if hw is not None and self.version < int(hw.get("version", -1)):
+            # monotone-incarnation guard: this incarnation restored a state
+            # OLDER than one a previous incarnation durably announced —
+            # either the checkpoint dir was rolled back to a stale snapshot
+            # or damage forced the restore past the newest round. Either
+            # way the peer must resync FORWARD (verified STATE_SYNC) before
+            # training or announcing: re-entering at the stale version
+            # would re-announce old versions as new.
+            self._needs_bootstrap = True
+            self._bootstrap_reason = "rollback"
+            logger.warning(
+                "peer %d: restored version %d is below the durable "
+                "high-water %d (rollback or damage fallback); resyncing "
+                "forward before rejoining", self.peer_id, self.version,
+                int(hw["version"]))
 
     # ------------------------------------------------------------- main loop
 
@@ -1365,6 +1673,10 @@ class PeerRuntime:
                 self._handle_reconcile(header, trees)
         elif kind == "hello":
             self._handle_hello(header)
+        elif kind == "state_sync_req":
+            self._handle_state_sync_req(header)
+        elif kind == "state_sync":
+            self._handle_state_sync(header, trees)
         elif kind == "shutdown":
             self._stop = True
         else:
@@ -1422,7 +1734,7 @@ class PeerRuntime:
         # an immediate partial report: from this instant on, even a peer
         # SIGKILLed seconds into the run leaves evidence behind
         self._write_report(status="running")
-        if self._resumed and self.peer_id != 0:
+        if self._resumed and self.peer_id != 0 and not self._needs_bootstrap:
             self.transport.send(0, {"type": "hello",
                                     "version": int(self.version)})
         try:
@@ -1435,6 +1747,14 @@ class PeerRuntime:
                     msg = self._next_ctrl(timeout_s=0.0)
                 if self._stop:
                     break
+                if self._needs_bootstrap:
+                    # damaged/empty/rolled-back durable state: repair FIRST.
+                    # No training, merging, or announcing until a verified
+                    # STATE_SYNC transfer is adopted — the idle watchdog
+                    # still bounds a repair that never completes.
+                    self._maybe_request_sync()
+                    time.sleep(0.05)
+                    continue
                 self._update_partition_state()
                 if self._pending_reconcile:
                     self._try_reconcile()
@@ -1538,6 +1858,11 @@ class PeerRuntime:
             "restored_reputation": getattr(self, "_restored_rep", None),
             "restored_from_version": getattr(
                 self, "_restored_from_version", None),
+            # durable-state repair evidence (RUNTIME.md "State-sync
+            # protocol"): why this peer bootstrapped and from whom —
+            # what the storage soak's convergence gates read
+            "bootstrap_reason": self._bootstrap_reason,
+            "repaired": self._repaired,
             "byzantine": (self.byz.stats() if self.byz is not None
                           else {"armed": False, "injected": {},
                                 "total": 0}),
@@ -1582,6 +1907,10 @@ def peer_main(argv=None) -> int:
                     help="comma-separated listen ports, one per peer")
     ap.add_argument("--run-dir", required=True)
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--bootstrap", action="store_true",
+                    help="with --resume: if no usable checkpoint survives, "
+                         "repair from a live peer over verified STATE_SYNC "
+                         "instead of failing loudly")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args(argv)
 
@@ -1604,6 +1933,12 @@ def peer_main(argv=None) -> int:
         from bcfl_tpu.dist.gossip import GossipPeerRuntime as Runtime
     else:
         Runtime = PeerRuntime
-    rt = Runtime(cfg, args.peer_id, ports, args.run_dir,
-                 resume=args.resume)
+    try:
+        rt = Runtime(cfg, args.peer_id, ports, args.run_dir,
+                     resume=args.resume, bootstrap=args.bootstrap)
+    except ResumeError as e:
+        # distinct exit code: "durable state unusable and repair not
+        # authorized" is an operator decision, not a crash
+        logger.error("%s", e)
+        return ResumeError.EXIT_CODE
     return rt.run()

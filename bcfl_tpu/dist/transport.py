@@ -106,10 +106,10 @@ class FailureDetector:
 
     Consecutive send-attempt failures move a peer REACHABLE -> SUSPECT
     (``suspect_after``) -> DOWN (``down_after``); any success snaps it back
-    to REACHABLE. While DOWN the circuit is open: :meth:`allow` returns
-    False except for one probe per ``probe_interval_s``. Thread-safe (the
-    serving threads never write it today, but the lock keeps that a
-    non-invariant)."""
+    to REACHABLE, as does INBOUND traffic from the peer
+    (:meth:`on_inbound`, called from the serving threads — hence the
+    lock). While DOWN the circuit is open: :meth:`allow` returns False
+    except for one probe per ``probe_interval_s``."""
 
     def __init__(self, peers: int, suspect_after: int = 2,
                  down_after: int = 6, probe_interval_s: float = 2.0):
@@ -165,6 +165,20 @@ class FailureDetector:
                 self._set(peer, DOWN)
             elif self._fails[peer] >= self.suspect_after:
                 self._set(peer, SUSPECT)
+
+    def on_inbound(self, peer: int) -> None:
+        """Liveness evidence from the RECEIVE path: a CRC-valid frame from
+        ``peer`` proves its process is up, so snap the circuit shut. A
+        just-restarted peer must not have its repair traffic refused
+        (``circuit_open``) for a whole probe interval by the stale DOWN
+        verdict its crash earned — its state_sync_req IS the heartbeat.
+        Unknown sender ids are ignored (hostile headers never grow the
+        peer table)."""
+        with self._lock:
+            if peer not in self._state:
+                return
+            self._fails[peer] = 0
+            self._set(peer, REACHABLE)
 
     def allow(self, peer: int) -> bool:
         """Should a send to ``peer`` be attempted now? True unless the
@@ -398,6 +412,9 @@ class PeerTransport:
                     _telemetry.emit("recv", disposition="hostile")
                     self._ack(conn)  # delivered garbage: never retryable
                     return
+                # even a frame the gate/dedup will discard is liveness
+                # evidence: the sender's PROCESS is demonstrably up
+                self.detector.on_inbound(src)
                 if (self.gate is not None
                         and not self.gate.allowed(self.peer_id, src)):
                     # the RECEIVER'S clock is authoritative: a frame from

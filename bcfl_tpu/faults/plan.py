@@ -72,7 +72,22 @@ into one seeded, deterministic, config-level schedule:
   bounded by ``byz_rounds``. The local engine exchanges no forgeable wire
   headers, so the capability table rejects the lane on
   ``runtime="local"`` (use ``corrupt_prob``/``flaky_*`` for the simulated
-  in-graph analogue).
+  in-graph analogue),
+- **storage** — durable-state damage for the dist runtime
+  (``runtime="dist"`` only; ROBUSTNESS.md §10 "Durable-state adversary
+  model"): each peer's freshly committed checkpoint is damaged at rest
+  per ``(peer, version)`` draw — torn writes, payload/meta bit rot,
+  truncation, deletion of the newest K rounds, ledger-chain tampering,
+  and rollback to an older intact snapshot (see :data:`STORAGE_CLASSES`).
+  Injected at the checkpoint write seam
+  (:func:`bcfl_tpu.checkpoint.checkpoint.apply_storage_fault`), detected
+  by the startup scrub, and recovered via the ledger-authenticated
+  STATE_SYNC peer repair (RUNTIME.md "State-sync protocol").
+  ``sync_tamper`` additionally corrupts the FIRST state-sync transfer a
+  listed (server, requester) pair serves, proving the receiver-side
+  refingerprint refuses unauthenticated state. The local engine has no
+  per-peer durable state to damage, so the capability table rejects the
+  lane on ``runtime="local"``.
 
 Everything is derived from ``(seed, fault lane, round)`` via
 ``np.random.default_rng`` — two engines with equal plans draw identical
@@ -111,11 +126,31 @@ _LANE_PARTITION = 4
 _LANE_FLAKY = 5
 _LANE_WIRE = 6
 _LANE_BYZ = 7
+_LANE_STORAGE = 8
 
 # the byzantine lane's behavior vocabulary (ROBUSTNESS.md §8): every name a
 # plan may draw, in the canonical order the seeded choice indexes into
 BYZ_BEHAVIORS = ("scale", "sign_flip", "garbage", "replay", "digest_forge",
                  "equivocate")
+
+# the storage lane's damage-class vocabulary (ROBUSTNESS.md §10): every
+# class a plan may draw, in the canonical order the seeded choice indexes
+# into. Each names one way a peer's DURABLE state (checkpoint payload, meta
+# sidecar, ledger chain) gets damaged at rest:
+#   torn         — the payload commit is interrupted mid-write (a staging
+#                  dir left where the committed round dir should be),
+#   payload_flip — one checkpoint payload byte flipped (silent bit rot),
+#   meta_flip    — one meta-sidecar byte flipped (digest/chain JSON rot),
+#   truncate     — the payload loses its tail (partial fsync loss),
+#   delete       — the newest K checkpoints removed outright,
+#   ledger       — one committed chain row tampered inside the newest meta
+#                  (the chain no longer verifies against its own links),
+#   rollback     — the whole checkpoint dir replaced by an older intact
+#                  snapshot (the restored-from-stale-backup case; locally
+#                  undetectable — only the chain high-water guard and peer
+#                  repair catch it).
+STORAGE_CLASSES = ("torn", "payload_flip", "meta_flip", "truncate",
+                   "delete", "ledger", "rollback")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -205,6 +240,21 @@ class FaultPlan:
     byz_prob: float = 1.0
     byz_scale: float = 25.0
     byz_rounds: Optional[Tuple[int, ...]] = None
+    # storage lane (runtime="dist" only): durable-state damage drawn per
+    # (peer, version) at the checkpoint write seam. `storage_peers` bounds
+    # the victims (None = every peer), each commit is damaged with
+    # `storage_prob`, the class drawn from `storage_classes` (a subset of
+    # STORAGE_CLASSES), `storage_delete_last` is K for the delete class,
+    # and `storage_rounds` bounds the lane to a span of the peer's version
+    # clock (None = every version). `sync_tamper` lists (server, requester)
+    # pairs whose FIRST state-sync transfer is byte-tampered in flight —
+    # the seeded needle proving the refingerprint refusal path fires.
+    storage_peers: Optional[Tuple[int, ...]] = None
+    storage_prob: float = 0.0
+    storage_classes: Tuple[str, ...] = STORAGE_CLASSES
+    storage_delete_last: int = 1
+    storage_rounds: Optional[Tuple[int, ...]] = None
+    sync_tamper: Optional[Tuple[Tuple[int, int], ...]] = None
 
     def __post_init__(self):
         for name in ("dropout_prob", "straggler_prob", "corrupt_prob"):
@@ -375,6 +425,63 @@ class FaultPlan:
             raise ValueError(
                 "byz_peers with byz_prob=0 would silently never act — "
                 "the exact vacuous-pass this lane must not have")
+        # --- storage lane ---
+        if not 0.0 <= self.storage_prob <= 1.0:
+            raise ValueError(
+                f"storage_prob must be in [0, 1], got {self.storage_prob}")
+        if self.storage_peers is not None:
+            if not (isinstance(self.storage_peers, tuple)
+                    and all(isinstance(p, int) and p >= 0
+                            for p in self.storage_peers)):
+                raise ValueError(
+                    "storage_peers must be a tuple of non-negative peer ids "
+                    "(hashable — the plan lives inside the frozen "
+                    "FedConfig)")
+            if len(set(self.storage_peers)) != len(self.storage_peers):
+                raise ValueError(
+                    f"storage_peers lists a peer twice: {self.storage_peers!r}")
+            if self.storage_prob <= 0.0:
+                raise ValueError(
+                    "storage_peers with storage_prob=0 would silently never "
+                    "damage anything — the exact vacuous-pass this lane "
+                    "must not have")
+        if not (isinstance(self.storage_classes, tuple)
+                and self.storage_classes):
+            raise ValueError("storage_classes must be a non-empty tuple")
+        bad = [c for c in self.storage_classes if c not in STORAGE_CLASSES]
+        if bad:
+            raise ValueError(
+                f"unknown storage damage classes {bad}; known: "
+                f"{STORAGE_CLASSES}")
+        if self.storage_delete_last < 1:
+            raise ValueError(
+                f"storage_delete_last must be >= 1, got "
+                f"{self.storage_delete_last}")
+        if self.storage_rounds is not None:
+            if not isinstance(self.storage_rounds, tuple):
+                raise ValueError("storage_rounds must be a tuple of version "
+                                 "indices (hashable — the plan lives inside "
+                                 "the frozen FedConfig)")
+            if not self.storage_rounds:
+                raise ValueError(
+                    "storage_rounds is empty: the storage lane would "
+                    "silently never fire (check the span bounds)")
+            if self.storage_prob <= 0.0:
+                raise ValueError(
+                    "storage_rounds without storage_prob > 0 would "
+                    "silently never damage a checkpoint")
+        if self.sync_tamper is not None:
+            if not (isinstance(self.sync_tamper, tuple)
+                    and all(isinstance(e, tuple) and len(e) == 2
+                            and isinstance(e[0], int) and isinstance(e[1], int)
+                            and e[0] >= 0 and e[1] >= 0 and e[0] != e[1]
+                            for e in self.sync_tamper)):
+                raise ValueError(
+                    "sync_tamper must be a tuple of distinct-id (server, "
+                    f"requester) peer pairs, got {self.sync_tamper!r}")
+            if len(set(self.sync_tamper)) != len(self.sync_tamper):
+                raise ValueError(
+                    f"sync_tamper lists a pair twice: {self.sync_tamper!r}")
 
     # ------------------------------------------------------------------ query
 
@@ -383,7 +490,8 @@ class FaultPlan:
         return (self.dropout_prob > 0 or self.straggler_prob > 0
                 or self.corrupt_prob > 0 or self.crash_at_round is not None
                 or self.partitions or self.churns or self.flaky_enabled
-                or self.wire_enabled or self.byz_enabled)
+                or self.wire_enabled or self.byz_enabled
+                or self.storage_enabled)
 
     @property
     def wire_enabled(self) -> bool:
@@ -394,6 +502,10 @@ class FaultPlan:
     @property
     def byz_enabled(self) -> bool:
         return bool(self.byz_peers)
+
+    @property
+    def storage_enabled(self) -> bool:
+        return self.storage_prob > 0 or bool(self.sync_tamper)
 
     @property
     def partitions(self) -> bool:
@@ -611,6 +723,57 @@ class FaultPlan:
         coordinates always replay the same bytes."""
         return np.random.default_rng(
             (self.seed, _LANE_BYZ, rnd, peer, dst, 1))
+
+    def storage_action(self, version: int, peer: int) -> Optional[dict]:
+        """Durable-state damage draw for ONE freshly committed checkpoint
+        of ``peer`` at ``version`` (the peer's global-version clock — the
+        round index its ``round_XXXXXX`` dir carries). Returns None when
+        the peer keeps its state intact, else::
+
+            {"cls": <one of this plan's storage_classes>,
+             "frac": <float in [0, 1) — the byte-offset fraction the flip/
+                      truncate classes damage at>,
+             "delete_last": storage_delete_last}
+
+        Identical ``(seed, version, peer)`` coordinates always draw the
+        identical damage — the injection is replayable, which is what lets
+        the unit tests pin per-class determinism and the soak assert every
+        class actually fired. The draw is consumed by
+        :func:`bcfl_tpu.checkpoint.checkpoint.apply_storage_fault` AFTER
+        the commit+fsync completes: the lane models media failure of
+        durable state, never an interrupted writer (the ``torn`` class
+        fabricates the leftover staging dir itself)."""
+        if self.storage_prob <= 0:
+            return None
+        if self.storage_peers is not None and peer not in self.storage_peers:
+            return None
+        if not self._due(self.storage_rounds, version):
+            return None
+        rng = np.random.default_rng(
+            (self.seed, _LANE_STORAGE, version, peer))
+        if rng.random() >= self.storage_prob:
+            return None
+        pick = int(rng.integers(len(self.storage_classes)))
+        return {"cls": self.storage_classes[pick],
+                "frac": float(rng.random()),
+                "delete_last": int(self.storage_delete_last)}
+
+    def sync_tamper_action(self, server: int, requester: int,
+                           serial: int) -> Optional[dict]:
+        """In-flight tamper draw for ONE state-sync transfer ``server`` is
+        about to serve ``requester`` (``serial`` counts that pair's serves,
+        0-based). Only the FIRST serve of a pair listed in ``sync_tamper``
+        is tampered — the requester refuses it (refingerprint mismatch),
+        re-requests, and the clean retry proves recovery; tampering every
+        serve would wedge the repair loop instead of needling it. Returns
+        ``{"frac": <byte-offset fraction to flip>}`` or None."""
+        if not self.sync_tamper or serial != 0:
+            return None
+        if (server, requester) not in self.sync_tamper:
+            return None
+        rng = np.random.default_rng(
+            (self.seed, _LANE_STORAGE, server, requester, 1))
+        return {"frac": float(rng.random())}
 
 
 class FaultInjector:

@@ -40,6 +40,19 @@ from bcfl_tpu.native.build import load_ledger_lib
 
 GENESIS = b"\x00" * 32
 
+# reserved ledger-row client ids for STATE_SYNC commitments (RUNTIME.md
+# "State-sync protocol"): real clients are >= 0 everywhere and reputation
+# rows live at REP_CLIENT_BASE(-1000) - peer, so rows at or below this base
+# can never collide with either. Peer p's state commitments use
+# SYNC_CLIENT_BASE - p; the row's digest slot carries the params digest of
+# the FULL global state p serves — the chain link that makes a transferred
+# state verifiable against committed history instead of merely plausible.
+SYNC_CLIENT_BASE = -2000
+
+
+def sync_row_client(peer: int) -> int:
+    return SYNC_CLIENT_BASE - int(peer)
+
 
 def chain_extend(prev: bytes, payload: bytes, use_native: bool = True) -> bytes:
     """One chain link: ``H(prev || payload)`` (C++ core when built)."""
@@ -293,6 +306,36 @@ class Ledger:
             self.heads.append(h)
             self.entries.append(entry)
         return -1
+
+    def commit_state(self, version: int, peer: int,
+                     state_digest: bytes) -> LedgerEntry:
+        """Append a reserved state-commitment row: ``peer`` attests that at
+        ``version`` its full global state hashes to ``state_digest``. Served
+        alongside a STATE_SYNC transfer, this is the receiving side's root
+        of trust — the transferred tree is refingerprinted and compared to
+        this row AFTER the chain segment carrying it verifies link-by-link
+        against the receiver's surviving prefix (a tampered state, a
+        tampered row, or a forked history all fail one of the two
+        checks)."""
+        if len(state_digest) != 32:
+            raise ValueError(
+                f"state commitment digest must be 32 bytes, got "
+                f"{len(state_digest)}")
+        return self.append_digest(int(version), sync_row_client(peer),
+                                  state_digest, 0)
+
+    @staticmethod
+    def find_state_commitment(rows: List[Dict], version: int,
+                              peer: int) -> Optional[bytes]:
+        """The state digest ``peer`` committed for ``version`` in a row
+        segment (newest match wins), or None. Rows are the JSON-able shape
+        :meth:`segment`/:meth:`to_json` produce — callers verify the
+        segment FIRST; an unverified row proves nothing."""
+        want = sync_row_client(peer)
+        for row in reversed(rows):
+            if int(row["client"]) == want and int(row["round"]) == int(version):
+                return bytes.fromhex(row["digest"])
+        return None
 
     def payload_accounting(self) -> Dict[str, float]:
         """Ledger-vs-full-weights communication sizes (GB), the quantity the

@@ -90,6 +90,20 @@ EVENT_TYPES: Dict[str, tuple] = {
     # --- checkpoints (bcfl_tpu.checkpoint) ---
     "ckpt.save": ("step",),
     "ckpt.restore": ("step",),
+    # startup durable-state audit (bcfl_tpu.checkpoint.scrub): one event
+    # per scrub pass; status: clean | damaged | empty. Damage detail
+    # (per-round classification, torn staging dirs) rides as extras.
+    "scrub": ("status",),
+    # --- STATE_SYNC peer repair (RUNTIME.md "State-sync protocol") ---
+    # reason: empty | damaged | rollback. The repair_authenticated
+    # invariant holds adopt to a preceding ok=True verify in the same
+    # peer incarnation; refusals name which gate fired (no_chain |
+    # bad_links | forked_prefix | no_commitment | digest_mismatch).
+    "state.sync.request": ("reason",),
+    "state.sync.serve": ("to",),
+    "state.sync.verify": ("ok",),
+    "state.sync.adopt": ("version",),
+    "state.sync.refuse": ("reason",),
     # --- reputation lifecycle (bcfl_tpu.reputation) ---
     "rep.evidence": ("client", "fault"),
     "rep.transition": ("client", "from", "to", "trust"),

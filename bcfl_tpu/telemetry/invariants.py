@@ -45,6 +45,20 @@ rule consumes):
   incarnation (peer, pid) and judged only against PEER-scoped
   ``rep.transition`` events (the local engine's client-scoped lifecycle
   shares the event types but talks about a different population).
+- **repair_authenticated** — a bootstrapping peer adopts foreign state
+  ONLY through the verified STATE_SYNC gates (RUNTIME.md "State-sync
+  protocol"): every ``state.sync.adopt`` must be preceded, in the same
+  peer incarnation, by a ``state.sync.verify`` with ``ok: true`` that no
+  earlier adopt already consumed. An unverified adoption is a peer
+  accepting arbitrary state on faith.
+- **no_rollback_readmission** — a restarted peer whose durable state was
+  rolled back (checkpoint chain shorter than an earlier incarnation's)
+  must resync FORWARD before persisting: a ``ckpt.save`` whose
+  ``chain_len`` sits below the maximum any EARLIER incarnation of the
+  same peer committed is a violation unless this incarnation already
+  repaired (``state.sync.adopt``) or declared a resync (``ledger``
+  ``op: "resync"``) first. Same-pid shrinkage is monotone_heads'
+  jurisdiction; this rule closes the across-restart hole.
 """
 
 from __future__ import annotations
@@ -273,6 +287,77 @@ def no_quarantined_merge(events: List[Dict]) -> List[Dict]:
     return out
 
 
+def repair_authenticated(events: List[Dict]) -> List[Dict]:
+    # per peer incarnation (stream peer, pid): a state.sync.adopt must
+    # consume a pending ok=True state.sync.verify from the SAME
+    # incarnation. Stream order is the peer's own seq order, so "verified
+    # before adopting" is exactly "verify seen earlier in this stream".
+    pending: Dict = {}  # (peer, pid) -> unconsumed verified-ok count
+    out = []
+    for e in events:
+        ev = e.get("ev")
+        if ev not in ("state.sync.verify", "state.sync.adopt"):
+            continue
+        key = (_peer_of(e), e.get("pid"))
+        if ev == "state.sync.verify":
+            if e.get("ok"):
+                pending[key] = pending.get(key, 0) + 1
+        else:
+            if pending.get(key, 0) > 0:
+                pending[key] -= 1
+            else:
+                out.append({
+                    "rule": "repair_authenticated",
+                    "problem": "state adopted without a preceding "
+                               "verified-ok STATE_SYNC in this "
+                               "incarnation",
+                    "peer": key[0], "pid": key[1],
+                    "version": e.get("version"), "src": e.get("src"),
+                })
+    return out
+
+
+def no_rollback_readmission(events: List[Dict]) -> List[Dict]:
+    # per PEER across incarnations: the high-water committed chain length
+    # is the max chain_len over EARLIER pids' ckpt.save events. A later
+    # pid persisting below that mark readmits rolled-back history —
+    # unless it already repaired forward (state.sync.adopt) or declared a
+    # resync (ledger op="resync") in its own stream first, which is the
+    # legitimate shorter-but-verified rejoin (a HELLO resync from a
+    # component whose chain forked shorter, or a repair from a peer that
+    # is itself slightly behind).
+    hw: Dict = {}       # peer -> (max chain_len, pid that set it)
+    exempt: set = set()  # (peer, pid) incarnations that repaired/resynced
+    out = []
+    for e in events:
+        ev = e.get("ev")
+        p = _peer_of(e)
+        key = (p, e.get("pid"))
+        if ev == "state.sync.adopt" or (ev == "ledger"
+                                        and e.get("op") == "resync"):
+            exempt.add(key)
+            continue
+        if ev != "ckpt.save":
+            continue
+        n = e.get("chain_len")
+        if n is None:
+            continue
+        prev = hw.get(p)
+        if (prev is not None and n < prev[0] and e.get("pid") != prev[1]
+                and key not in exempt):
+            out.append({
+                "rule": "no_rollback_readmission",
+                "problem": "restarted peer persisted a chain below an "
+                           "earlier incarnation's committed high-water "
+                           "without repairing forward",
+                "peer": p, "pid": e.get("pid"),
+                "prev_len": prev[0], "prev_pid": prev[1], "new_len": n,
+            })
+        if prev is None or n >= prev[0]:
+            hw[p] = (n, e.get("pid"))
+    return out
+
+
 # name -> (check fn, one-line description); the collator and the trace CLI
 # walk this registry — adding a rule here adds it to every consumer
 INVARIANTS = {
@@ -296,6 +381,14 @@ INVARIANTS = {
         no_quarantined_merge,
         "no merge lineage includes an arrival from a peer quarantined at "
         "that leader (per incarnation)"),
+    "repair_authenticated": (
+        repair_authenticated,
+        "every STATE_SYNC adoption is preceded by a verified-ok transfer "
+        "in the same incarnation"),
+    "no_rollback_readmission": (
+        no_rollback_readmission,
+        "no restarted peer persists below an earlier incarnation's "
+        "committed chain high-water without repairing forward"),
 }
 
 

@@ -14,7 +14,7 @@ exit. This module is the live counterpart, three layers:
   a finalize meta that matches :func:`collate.read_stream` byte-for-byte
   on any closed stream. Memory is O(live identities), never O(stream
   bytes).
-- **Streaming invariants** — incremental forms of the six
+- **Streaming invariants** — incremental forms of the
   :mod:`invariants` checks with windowed state (the merged-identity set
   per leader incarnation, the acked-awaiting-recv map with grace expiry).
   Violations are emitted the moment they become decidable. Parity
@@ -427,10 +427,77 @@ class SNoQuarantinedMerge(_StreamingCheck):
         return new
 
 
+class SRepairAuthenticated(_StreamingCheck):
+    name = "repair_authenticated"
+
+    def __init__(self):
+        super().__init__()
+        self._pending: Dict = {}   # (peer, pid) -> unconsumed verified-ok
+
+    def feed(self, e: Dict) -> List[Dict]:
+        ev = e.get("ev")
+        if ev not in ("state.sync.verify", "state.sync.adopt"):
+            return []
+        key = (e.get("peer"), e.get("pid"))
+        if ev == "state.sync.verify":
+            if e.get("ok"):
+                self._pending[key] = self._pending.get(key, 0) + 1
+            return []
+        if self._pending.get(key, 0) > 0:
+            self._pending[key] -= 1
+            return []
+        v = {"rule": self.name,
+             "problem": "state adopted without a preceding verified-ok "
+                        "STATE_SYNC in this incarnation",
+             "peer": key[0], "pid": key[1],
+             "version": e.get("version"), "src": e.get("src")}
+        self.out.append(v)
+        return [v]
+
+
+class SNoRollbackReadmission(_StreamingCheck):
+    name = "no_rollback_readmission"
+
+    def __init__(self):
+        super().__init__()
+        self._hw: Dict = {}      # peer -> (max chain_len, pid)
+        self._exempt: set = set()  # (peer, pid) that repaired/resynced
+
+    def feed(self, e: Dict) -> List[Dict]:
+        ev = e.get("ev")
+        p = e.get("peer")
+        key = (p, e.get("pid"))
+        if ev == "state.sync.adopt" or (ev == "ledger"
+                                        and e.get("op") == "resync"):
+            self._exempt.add(key)
+            return []
+        if ev != "ckpt.save":
+            return []
+        n = e.get("chain_len")
+        if n is None:
+            return []
+        prev = self._hw.get(p)
+        new: List[Dict] = []
+        if (prev is not None and n < prev[0] and e.get("pid") != prev[1]
+                and key not in self._exempt):
+            new.append({
+                "rule": self.name,
+                "problem": "restarted peer persisted a chain below an "
+                           "earlier incarnation's committed high-water "
+                           "without repairing forward",
+                "peer": p, "pid": e.get("pid"),
+                "prev_len": prev[0], "prev_pid": prev[1], "new_len": n})
+        if prev is None or n >= prev[0]:
+            self._hw[p] = (n, e.get("pid"))
+        self.out.extend(new)
+        return new
+
+
 # registry mirrors invariants.INVARIANTS key-for-key (tested)
 STREAMING_CHECKS = {c.name: c for c in (
     SNoDoubleMerge, SAckedNotLost, SNoCrossPartitionMerge,
-    SQuarantineEvidence, SMonotoneHeads, SNoQuarantinedMerge)}
+    SQuarantineEvidence, SMonotoneHeads, SNoQuarantinedMerge,
+    SRepairAuthenticated, SNoRollbackReadmission)}
 
 
 class StreamingInvariantSuite:

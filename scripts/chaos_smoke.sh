@@ -531,4 +531,105 @@ print("gossip leg: survivors reached version "
       f"SIGKILL, {gmerges} gossip merges, {leaves} membership.leave "
       "records, monitor + batch trace CLEAN")
 EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Storage-chaos leg (ROBUSTNESS.md §10 "Durable-state adversary model"):
+# 2 peers, follower SIGKILLed mid-run, its NEWEST committed checkpoint
+# bit-flipped WHILE IT IS DOWN (supervisor-side injection — the media
+# failure happens between fsync and restart), rejoin with
+# --resume --bootstrap. Gates: the startup scrub classifies the damage
+# (scrub status=damaged in the stream), the fallback-to-older-round trips
+# the monotone-incarnation guard, the repair rides STATE_SYNC with a
+# chain-verified transfer (state.sync.verify ok + state.sync.adopt
+# observed), the fleet reaches the horizon, and the full invariant suite
+# — including repair_authenticated and no_rollback_readmission — is
+# clean LIVE (monitor exit 0) and post-hoc (batch trace) with verdict
+# parity. The full matrix (every damage class + the in-process seeded
+# lane + tamper-refusal proof) is scripts/dist_soak.py --storage.
+echo
+echo "storage leg: 2 peers, SIGKILL + checkpoint bit-flip, --bootstrap repair"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
+                             PartitionConfig)
+from bcfl_tpu.dist.harness import run_dist
+from bcfl_tpu.telemetry import collate, read_stream
+
+run_dir = "/tmp/bcfl_chaos_storage_run"
+if os.path.isdir(run_dir):
+    shutil.rmtree(run_dir)
+os.makedirs(run_dir)
+stop = os.path.join(run_dir, "monitor.stop")
+summary_path = "/tmp/bcfl_chaos_storage_summary.json"
+mon = subprocess.Popen(
+    [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor", run_dir,
+     "--quiet", "--poll", "0.5", "--stop-file", stop,
+     "--summary-out", summary_path, "--max-wall", "500", "--idle", "400",
+     "--stall-critical-s", "600"])
+cfg = FedConfig(
+    name="storage_smoke", runtime="dist", mode="server", sync="async",
+    model="tiny-bert", dataset="synthetic", num_clients=4, num_rounds=6,
+    seq_len=16, batch_size=4, max_local_batches=2, eval_every=0, seed=42,
+    partition=PartitionConfig(kind="iid", iid_samples=8),
+    ledger=LedgerConfig(enabled=True),
+    # quorum_frac=0.9: with 2 peers the leader refuses to advance while
+    # the follower is DOWN — it must wait (bounded by the idle watchdog)
+    # for the repaired peer instead of racing to the horizon alone and
+    # leaving the bootstrapper nobody to sync from
+    dist=DistConfig(peers=2, buffer_timeout_s=10.0, idle_timeout_s=90.0,
+                    peer_deadline_s=300.0, checkpoint_every_versions=1,
+                    checkpoint_keep_last=3, suspect_after=1,
+                    quorum_frac=0.9))
+try:
+    result = run_dist(cfg, run_dir, deadline_s=400.0, platform="cpu",
+                      churn={"peer": 1, "cycles": 1, "period_s": 6.0,
+                             "downtime_s": 2.0, "stop_after_s": 120.0,
+                             "damage": ["payload_flip"],
+                             "bootstrap": True})
+finally:
+    with open(stop, "w") as f:
+        f.write("done\n")
+mon_rc = mon.wait(timeout=120)
+assert result["ok"], (result["returncodes"], result["log_tails"])
+churn = result["churn"]
+assert churn, "the churn kill never fired (no checkpoint before stop_after?)"
+dmg = churn[0].get("damage") or {}
+assert dmg.get("cls") == "payload_flip", churn
+scrub_damaged = verify_ok = adopts = 0
+for path in result["event_streams"]:
+    evs, _ = read_stream(path)
+    for e in evs:
+        if e["ev"] == "scrub" and e.get("status") == "damaged":
+            scrub_damaged += 1
+        elif e["ev"] == "state.sync.verify" and e.get("ok"):
+            verify_ok += 1
+        elif e["ev"] == "state.sync.adopt":
+            adopts += 1
+assert scrub_damaged > 0, "the bit-flip never surfaced in a startup scrub"
+assert verify_ok > 0, "no chain-verified STATE_SYNC transfer observed"
+assert adopts > 0, "the damaged peer never adopted a repair"
+assert mon_rc == 0, f"live monitor exited {mon_rc} on the storage run"
+col = collate(result["event_streams"])
+col.pop("ordered")
+assert col["ok"], col["violations"]
+with open(summary_path) as f:
+    mon_summary = json.load(f)
+assert mon_summary["invariants"] == col["invariants"], (
+    "monitor-vs-trace verdict drift", mon_summary["invariants"],
+    col["invariants"])
+for rule in ("repair_authenticated", "no_rollback_readmission"):
+    assert rule in col["invariants"], f"{rule} missing from the batch suite"
+print("storage leg: scrub flagged the damage, repair verified+adopted "
+      f"over STATE_SYNC ({verify_ok} verify-ok, {adopts} adopt), final "
+      f"versions leader={result['reports'][0].get('final_version')} "
+      f"repaired={result['reports'][1].get('final_version')}, "
+      "monitor + batch trace CLEAN (repair_authenticated, "
+      "no_rollback_readmission armed)")
+EOF
 exit $?

@@ -275,7 +275,7 @@ def _resume_gates(result, cfg, leader: dict, adversary: int,
         ckpt_dir = os.path.join(result["run_dir"], "ckpt_peer0")
         disk = restore_checkpoint(ckpt_dir, int(from_version))
         if disk is not None:
-            state, _ledger = disk
+            _rnd, state, _ledger = disk
             bit_identical = (
                 restored["trust_hex"] == [
                     float(t).hex() for t in state["rep_trust"]]

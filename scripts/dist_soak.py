@@ -14,6 +14,21 @@ long-horizon composition none of them exercises:
   crash/rejoin path, exercised in a loop),
 - **resource sampling** — every peer emits periodic catalogued
   ``resource`` events (``DistConfig.resource_sample_s``),
+- **storage (``--storage``)** — the durable-state adversary
+  (ROBUSTNESS.md §10): the churned follower's checkpoint directory is
+  damaged while it is down, cycling through EVERY damage class
+  (checkpoint.STORAGE_CLASSES — torn write, payload/meta bit-flip,
+  truncation, deletion, ledger-chain corruption, clean rollback), the
+  in-process seeded lane 8 (``FaultPlan.storage_*``) additionally
+  damages its own fresh checkpoints post-commit, rejoins run ``--resume
+  --bootstrap`` so scrubbed-unusable state repairs over verified
+  STATE_SYNC, checkpoint retention (``checkpoint_keep_last``) runs hot,
+  and one seeded ``sync_tamper`` serve proves the refingerprint gate
+  refuses a tampered transfer. Three extra gates ride the lane: every
+  damage class actually injected, >= 1 STATE_SYNC repair adopted, and
+  the tampered transfer provably refused — on top of the zero-violation
+  gates, which now include ``repair_authenticated`` and
+  ``no_rollback_readmission``,
 
 while ``bcfl-tpu monitor`` is attached CONCURRENTLY in ``--fail-fast``
 mode: a watcher thread reaps the whole fleet the moment the monitor exits
@@ -53,7 +68,7 @@ assumed).
 
 Usage: python scripts/dist_soak.py [--rounds 120] [--peers 3]
            [--deadline 2700] [--platform cpu] [--quick]
-           [--dispatch {leader,gossip}]
+           [--dispatch {leader,gossip}] [--storage]
 """
 
 from __future__ import annotations
@@ -91,6 +106,7 @@ def build_cfg(args, dispatch=None, name="dist_soak"):
 
     dispatch = dispatch or args.dispatch
 
+    storage = bool(getattr(args, "storage", False))
     plan = FaultPlan(
         seed=args.chaos_seed,
         wire_drop_prob=args.wire_drop, wire_dup_prob=args.wire_dup,
@@ -99,7 +115,14 @@ def build_cfg(args, dispatch=None, name="dist_soak"):
         wire_corrupt_prob=args.wire_corrupt,
         # the adversary lies for the WHOLE horizon, not a burst
         byz_peers=(args.peers - 1,), byz_prob=1.0,
-        byz_behaviors=("scale", "digest_forge"))
+        byz_behaviors=("scale", "digest_forge"),
+        # storage lane (in-process half): the churned follower damages
+        # its OWN fresh checkpoints post-commit on seeded draws; the
+        # leader's first STATE_SYNC serve to it is tampered in flight —
+        # the refusal that provokes is the refingerprint-gate proof
+        **({"storage_peers": (1,),
+            "storage_prob": args.storage_prob,
+            "sync_tamper": ((0, 1),)} if storage else {}))
     return FedConfig(
         name=name, runtime="dist", mode="server", sync="async",
         model=args.model, dataset="synthetic",
@@ -125,6 +148,10 @@ def build_cfg(args, dispatch=None, name="dist_soak"):
             idle_timeout_s=args.idle_timeout,
             peer_deadline_s=args.deadline,
             checkpoint_every_versions=5,
+            # storage lane: retention runs hot (GC strictly after
+            # commit+fsync), so the scrub surface stays small and the
+            # keep-last ordering is soak-proven, not just unit-tested
+            checkpoint_keep_last=3 if storage else 0,
             suspect_after=1,
             # satellite: periodic catalogued `resource` events from every
             # peer, rolled into the monitor's health series
@@ -185,6 +212,15 @@ def main(argv=None) -> int:
                     help="seconds between kill/rejoin cycles of peer 1")
     ap.add_argument("--churn-downtime", type=float, default=2.0)
     ap.add_argument("--resource-sample-s", type=float, default=2.0)
+    ap.add_argument("--storage", action="store_true",
+                    help="arm the durable-state adversary: damage the "
+                         "churned peer's checkpoints (every class), "
+                         "rejoin with --resume --bootstrap, tamper one "
+                         "STATE_SYNC serve, run retention hot "
+                         "(ROBUSTNESS.md §10)")
+    ap.add_argument("--storage-prob", type=float, default=0.3,
+                    help="in-process seeded lane-8 damage probability "
+                         "per committed checkpoint of the churned peer")
     ap.add_argument("--dispatch", choices=("leader", "gossip"),
                     default="leader",
                     help="dist execution mode; 'gossip' soaks the "
@@ -213,6 +249,12 @@ def main(argv=None) -> int:
         args.churn_cycles = 1
         args.churn_period = 20.0
         args.deadline = min(args.deadline, 900.0)
+    from bcfl_tpu.faults.plan import STORAGE_CLASSES
+
+    if args.storage and not args.quick:
+        # the every-class-injected gate needs one supervisor-side kill
+        # per damage class (the in-process seeded lane only supplements)
+        args.churn_cycles = max(args.churn_cycles, len(STORAGE_CLASSES))
     if args.peers < 3:
         print("dist_soak needs >= 3 peers (trimmed_mean around one "
               "adversary + a churning follower)", file=sys.stderr)
@@ -236,11 +278,18 @@ def main(argv=None) -> int:
     churn = {"peer": churn_peer, "cycles": args.churn_cycles,
              "period_s": args.churn_period,
              "downtime_s": args.churn_downtime,
-             "stop_after_s": args.deadline * 0.5}
+             "stop_after_s": args.deadline * 0.5,
+             # storage lane (supervisor half): damage the corpse's
+             # durable state each cycle, one class per kill in catalogue
+             # order, and rejoin with --resume --bootstrap so unusable
+             # state repairs over verified STATE_SYNC
+             **({"damage": list(STORAGE_CLASSES), "bootstrap": True}
+                if args.storage else {})}
 
+    lanes = "wire+byzantine+churn" + ("+storage" if args.storage else "")
     print(f"dist_soak[{args.dispatch}]: {args.peers} peers x "
           f"{args.clients // args.peers} clients, target {args.rounds} "
-          f"versions; wire+byzantine+churn armed, monitor attached live "
+          f"versions; {lanes} armed, monitor attached live "
           f"-> {run_dir}", flush=True)
     t0 = time.time()
     mon = attach_monitor(run_dir, stop_path, summary_path, args)
@@ -321,12 +370,27 @@ def main(argv=None) -> int:
 
     resource_samples = 0
     membership_events = 0
+    storage_chaos_classes = set()    # in-process lane-8 injections
+    sync_adopts = sync_refusals = tampered_serves = 0
+    tamper_refused = 0               # refusals with the tamper's signature
     for path in result["event_streams"]:
         evs, _ = read_stream(path)
-        resource_samples += sum(1 for e in evs if e["ev"] == "resource")
-        membership_events += sum(
-            1 for e in evs
-            if e["ev"] in ("membership.join", "membership.leave"))
+        for e in evs:
+            ev = e["ev"]
+            if ev == "resource":
+                resource_samples += 1
+            elif ev in ("membership.join", "membership.leave"):
+                membership_events += 1
+            elif ev == "chaos" and e.get("lane") == "storage":
+                storage_chaos_classes.add(e.get("action"))
+            elif ev == "state.sync.adopt":
+                sync_adopts += 1
+            elif ev == "state.sync.refuse":
+                sync_refusals += 1
+                if e.get("reason") == "digest_mismatch":
+                    tamper_refused += 1
+            elif ev == "state.sync.serve" and e.get("tampered"):
+                tampered_serves += 1
 
     if args.dispatch == "gossip":
         # leaderless: there is no peer whose clock speaks for the fleet —
@@ -392,6 +456,21 @@ def main(argv=None) -> int:
             rep.get("chain_ok") in (True, None)
             for rep in reports.values()),
     }
+    storage_damage_classes = set()
+    if args.storage:
+        # supervisor-side injections (one class per churn cycle) union
+        # the in-process seeded lane's chaos events
+        storage_damage_classes = {
+            (rec.get("damage") or {}).get("cls")
+            for rec in (result.get("churn") or [])} - {None}
+        storage_damage_classes |= storage_chaos_classes - {None}
+        required = (set(STORAGE_CLASSES) if not args.quick else set())
+        gates["storage_all_classes_injected"] = (
+            bool(storage_damage_classes)
+            and required <= storage_damage_classes)
+        gates["storage_repairs_adopted"] = sync_adopts > 0
+        gates["storage_tampered_transfer_refused"] = (
+            tampered_serves > 0 and tamper_refused > 0)
     gossip_loss = None
     if args.dispatch == "gossip":
         gossip_loss = _mean_final_loss(reports)
@@ -420,6 +499,16 @@ def main(argv=None) -> int:
             "churn": {"peer": churn_peer,
                       "cycles": result.get("churn"),
                       "membership_events": membership_events},
+            "storage": ({
+                "armed": True, "prob": args.storage_prob,
+                "classes_injected": sorted(storage_damage_classes),
+                "inprocess_classes": sorted(
+                    storage_chaos_classes - {None}),
+                "sync_adopts": sync_adopts,
+                "sync_refusals": sync_refusals,
+                "tampered_serves": tampered_serves,
+                "tamper_refused": tamper_refused,
+            } if args.storage else None),
             "resource_sample_s": args.resource_sample_s,
         },
         "convergence": ({"gossip_loss": gossip_loss,

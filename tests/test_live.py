@@ -141,6 +141,32 @@ def _fixtures():
 
     out.append(("quarantined_merge", _quarantined_merge_run(),
                 {"no_quarantined_merge"}))
+
+    # storage-repair lanes (ROBUSTNESS.md §10): an adopt must consume a
+    # verified-ok STATE_SYNC in its own incarnation...
+    adopt = _ev("state.sync.adopt", "B", 5, 21.0, version=3, src=0)
+    out.append(("unauthenticated_adopt", tt._clean_run() + [adopt],
+                {"repair_authenticated"}))
+    verify = _ev("state.sync.verify", "B", 5, 20.5, ok=True, src=0,
+                 version=3)
+    out.append(("authenticated_adopt",
+                tt._clean_run() + [verify, dict(adopt, seq=6)], set()))
+    # ...and a restarted peer may not persist a chain below an earlier
+    # incarnation's committed high-water unless it repaired forward first
+    save_hi = _ev("ckpt.save", "B", 5, 21.0, step=3, chain_len=6, gc=0)
+    save_lo = _ev("ckpt.save", "B", 0, 30.0, pid=99999, step=1,
+                  chain_len=2, gc=0)
+    out.append(("rollback_readmission",
+                tt._clean_run() + [save_hi, save_lo],
+                {"no_rollback_readmission"}))
+    out.append(("rollback_repaired_exempt",
+                tt._clean_run() + [
+                    save_hi,
+                    _ev("state.sync.verify", "B", 0, 29.0, pid=99999,
+                        ok=True, src=0, version=1),
+                    _ev("state.sync.adopt", "B", 1, 29.5, pid=99999,
+                        version=1, src=0),
+                    dict(save_lo, seq=2)], set()))
     return out
 
 

@@ -177,11 +177,30 @@ class GuardedByChecker(Checker):
         out: List[Finding] = []
         if src.tree is None:
             return out
-        for cls in [n for n in ast.walk(src.tree)
-                    if isinstance(n, ast.ClassDef)]:
-            info = _scan_class(src, cls)
+        classes = [n for n in ast.walk(src.tree)
+                   if isinstance(n, ast.ClassDef)]
+        scanned = {cls.name: (_scan_class(src, cls), cls)
+                   for cls in classes}
+
+        def merged_locks(name: str, seen: Tuple[str, ...]) -> Dict[str, str]:
+            # a subclass guards state with the base's lock (e.g. the phi
+            # detector reuses FailureDetector._lock) — resolve lock attrs
+            # through same-file bases so those registrations still verify
+            info, cls = scanned[name]
+            locks = dict(info.locks)
+            for b in cls.bases:
+                base = b.id if isinstance(b, ast.Name) else None
+                if base in scanned and base not in seen:
+                    for k, v in merged_locks(base, seen + (name,)).items():
+                        locks.setdefault(k, v)
+            return locks
+
+        for cls in classes:
+            info, _ = scanned[cls.name]
             if not info.guarded:
                 continue
+            info = dataclasses.replace(
+                info, locks=merged_locks(cls.name, ()))
             # fail-loudly on a registration naming a lock that is not a
             # lock attribute of this class (typo'd annotations must not
             # silently un-guard a field)

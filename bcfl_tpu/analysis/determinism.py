@@ -46,9 +46,10 @@ SEEDED_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     "dist/robust.py": None,
     # evidence aggregation order feeds the committed reputation rows
     "reputation/dist.py": None,
-    # the wire chaos lane's draw seam (the rest of transport.py is
-    # wall-clock country: deadlines, backoff, detector probes)
-    "dist/transport.py": ("WireChaos",),
+    # the wire + limp chaos lanes' draw seams (the rest of transport.py
+    # is wall-clock country: deadlines, backoff, detector probes — the
+    # phi estimator MEASURES the live run and is excluded by design)
+    "dist/transport.py": ("WireChaos", "LimpChaos"),
     # votes_by_peer construction: peer iteration order reaches the
     # lineage record and the krum-selected-peer translation
     "dist/runtime.py": ("_apply_robust_merge",),
@@ -56,8 +57,8 @@ SEEDED_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     # the canonical-order commutative merge, and the state digest — the
     # GossipPeerRuntime class around them is wall-clock country
     # (hello cadence, drain windows, arrival latencies)
-    "dist/gossip.py": ("sample_neighbors", "merge_states",
-                       "state_digest", "_walk_sorted"),
+    "dist/gossip.py": ("sample_neighbors", "hedge_neighbors",
+                       "merge_states", "state_digest", "_walk_sorted"),
 }
 
 _WALLCLOCK = {"time", "monotonic", "time_ns", "monotonic_ns",

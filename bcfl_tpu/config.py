@@ -154,6 +154,48 @@ class DistConfig:
     suspect_after: int = 2
     down_after: int = 6
     probe_interval_s: float = 2.0
+    # --- failure-detection mode (RUNTIME.md "Timing contract") ---
+    # "phi" (default) = adaptive phi-accrual-style estimator: per-peer
+    # inbound-interval EWMA + variance feed a CONTINUOUS suspicion level
+    # phi (monotone in silence, snapped back by any liveness evidence);
+    # suspect/down become thresholds on phi and send deadlines adapt per
+    # destination from measured RTT/throughput (floor/ceiling clamped
+    # below). "fixed" = the consecutive-counter detector above with the
+    # static send_deadline_s — bit-compatible with pre-gray-failure
+    # replays (the knob the existing dist_chaos legs pin).
+    detector: str = "phi"
+    # phi thresholds: suspicion grows by 1 per consecutive failed send
+    # attempt plus the peer's silence beyond its adaptive expected window
+    # (so the defaults grade like suspect_after=2 / down_after=6 under
+    # pure failures, while pure silence also accrues — the gray-failure
+    # signal the fixed counter is blind to)
+    phi_suspect: float = 2.0
+    phi_down: float = 6.0
+    # clamp on the adaptive expected-silence window (EWMA mean + 3 sigma
+    # of inbound intervals): the floor keeps a chatty link from making
+    # sub-second silences suspicious, the ceiling bounds how long an
+    # unheard-from peer can stay unsuspected
+    phi_window_floor_s: float = 5.0
+    phi_window_ceil_s: float = 120.0
+    # clamp on the adaptive per-destination send deadline (measured RTT
+    # headroom + frame_bytes / measured throughput). floor bounds how
+    # aggressive a fast link's deadline may get; ceiling bounds how long
+    # a limping link can hold a send. detector="fixed" ignores both and
+    # uses send_deadline_s verbatim.
+    deadline_floor_s: float = 2.0
+    deadline_ceil_s: float = 120.0
+    # assumed link throughput (bytes/s) before any measurement exists:
+    # the size-proportional term of the adaptive deadline divides by this
+    # until real throughput samples arrive, so a first-contact 32 MB
+    # frame gets a budget that scales with its size instead of starving
+    # under a latency-tuned constant (the PR 8 large-frame starvation
+    # note)
+    min_bandwidth_bps: float = 1_048_576.0
+    # gossip hedging: when a sampled neighbor's phi crosses this
+    # threshold at dispatch time, the peer re-draws a seeded replacement
+    # neighbor (detector="phi" only; the draw is replayable — see
+    # bcfl_tpu.dist.gossip.HEDGE_LANE)
+    gossip_hedge_phi: float = 2.0
     # receiver-side per-sender dedup window (message ids); ids at or below
     # (newest seen - window) are treated as duplicates and dropped
     dedup_window: int = 1024
@@ -239,6 +281,27 @@ class DistConfig:
             raise ValueError(
                 f"down_after {self.down_after} must be >= suspect_after "
                 f"{self.suspect_after} (a peer is SUSPECT before DOWN)")
+        if self.detector not in ("phi", "fixed"):
+            raise ValueError(
+                f"dist detector must be 'phi' or 'fixed', got "
+                f"{self.detector!r}")
+        for name in ("phi_suspect", "phi_window_floor_s",
+                     "deadline_floor_s", "min_bandwidth_bps",
+                     "gossip_hedge_phi"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0")
+        if self.phi_down < self.phi_suspect:
+            raise ValueError(
+                f"phi_down {self.phi_down} must be >= phi_suspect "
+                f"{self.phi_suspect} (a peer is SUSPECT before DOWN)")
+        if self.phi_window_ceil_s < self.phi_window_floor_s:
+            raise ValueError(
+                f"phi_window_ceil_s {self.phi_window_ceil_s} must be >= "
+                f"phi_window_floor_s {self.phi_window_floor_s}")
+        if self.deadline_ceil_s < self.deadline_floor_s:
+            raise ValueError(
+                f"deadline_ceil_s {self.deadline_ceil_s} must be >= "
+                f"deadline_floor_s {self.deadline_floor_s}")
         for name in ("dedup_window", "inbox_max"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
@@ -446,6 +509,25 @@ RUNTIME_CAPS: Tuple = (
     # (faults/plan.py lane 8); detection is the startup scrub +
     # restore-time classification, recovery is the ledger-authenticated
     # STATE_SYNC transfer (ROBUSTNESS.md §10)
+    ("chaos: limp faults (gray failures)",
+     lambda c: c.faults.limp_enabled,
+     {"local": "the limp lane stalls a PEER's train seam and throttles "
+               "its real TCP links, graded by the adaptive failure "
+               "detector and w_slow down-weighting — the local engine "
+               "has neither a wire nor a detector; use straggler_prob "
+               "for the simulated-clock analogue",
+      "dist": True}),  # stall at the train seam, direction-keyed
+    # throttle in the transport, SIGSTOP pauses via the harness
+    # (faults/plan.py lane 9; ROBUSTNESS.md §11)
+    ("chaos: resource faults (ENOSPC/EMFILE)",
+     lambda c: c.faults.resource_enabled,
+     {"local": "the resource lane fails a peer's durable writes "
+               "(checkpoint commit, ledger append, event flush) and "
+               "grades the emergency-GC → telemetry-shed → exit ladder; "
+               "the local engine has no per-peer durable-write seams — "
+               "dist only",
+      "dist": True}),  # drawn per (seam, counter, peer) at the write
+    # seams (faults/plan.py lane 10; ROBUSTNESS.md §11)
     # --- gossip-dispatch composition rows (RUNTIME.md "Gossip dispatch"):
     # active only when the dist runtime is asked for dispatch='gossip', so
     # they never fire for local runs or the leadered dist path ---
@@ -814,6 +896,20 @@ class FedConfig:
                         "ledger.enabled there is no root of trust to "
                         "verify a transfer against — enable the ledger "
                         "or drop the lane")
+            if self.faults.limp_enabled and self.faults.limp_peers:
+                bad = [p for p in self.faults.limp_peers
+                       if p >= self.dist.peers]
+                if bad:
+                    raise ValueError(
+                        f"limp_peers name PEERS; ids {bad} are >= peers="
+                        f"{self.dist.peers}")
+            if self.faults.resource_enabled and self.faults.resource_peers:
+                bad = [p for p in self.faults.resource_peers
+                       if p >= self.dist.peers]
+                if bad:
+                    raise ValueError(
+                        f"resource_peers name PEERS; ids {bad} are >= "
+                        f"peers={self.dist.peers}")
             if self.aggregator != "mean":
                 # robust aggregators are supported on dist WITH declared
                 # preconditions on the merge buffer (RUNTIME.md §5): the

@@ -53,13 +53,15 @@ import numpy as np
 
 from bcfl_tpu import telemetry
 from bcfl_tpu.dist.membership import MembershipView
-from bcfl_tpu.dist.runtime import MergeRecord, PeerRuntime, logger
+from bcfl_tpu.dist.runtime import (DurabilityError, MergeRecord,
+                                   PeerRuntime, logger)
 
 # rng lane tags: the neighbor draw and the hello-target draw must be
 # DIFFERENT streams of the same seed (same (seed, round, peer) coordinates,
 # different purpose), like the faults/plan.py lane constants
 GOSSIP_LANE = 71
 HELLO_LANE = 72
+HEDGE_LANE = 73
 
 
 def _walk_sorted(tree, prefix: str = ""):
@@ -118,6 +120,38 @@ def sample_neighbors(seed: int, round_idx: int, peer: int,
         (int(seed), int(lane), int(round_idx), int(peer)))
     pick = rng.choice(len(others), size=k, replace=False)
     return tuple(others[i] for i in sorted(pick))
+
+
+def hedge_neighbors(seed: int, round_idx: int, peer: int,
+                    live: Tuple[int, ...], nbrs: Tuple[int, ...],
+                    suspicion: Dict[int, float],
+                    threshold: float) -> Tuple[Tuple[int, ...],
+                                               Tuple[int, ...]]:
+    """Suspicion-hedged redraw of one round's sampled neighbors
+    (ROBUSTNESS.md §11): a sampled neighbor whose phi suspicion has
+    crossed ``threshold`` is DROPPED and a replacement is drawn — from
+    its own seeded lane, so the hedge is replayable like the sample it
+    amends — out of the non-suspicious remainder of the live view. When
+    the replacement pool is empty the fanout simply shrinks: gossiping
+    to fewer healthy peers beats insisting on a limping one (the paced
+    send would eat the round's wall budget for an exchange the next
+    round's draw retries anyway). Returns ``(new_nbrs, dropped)``;
+    with nothing suspicious the sample passes through untouched."""
+    dropped = tuple(n for n in nbrs
+                    if suspicion.get(int(n), 0.0) >= threshold)
+    if not dropped:
+        return tuple(nbrs), ()
+    kept = [int(n) for n in nbrs if n not in dropped]
+    pool = [p for p in sorted(int(x) for x in live)
+            if p != int(peer) and p not in kept
+            and suspicion.get(p, 0.0) < threshold]
+    k = min(len(dropped), len(pool))
+    if k > 0:
+        rng = np.random.default_rng(
+            (int(seed), HEDGE_LANE, int(round_idx), int(peer)))
+        pick = rng.choice(len(pool), size=k, replace=False)
+        kept.extend(pool[i] for i in sorted(pick))
+    return tuple(kept), dropped
 
 
 def merge_states(items: List[Dict], decay: float):
@@ -326,6 +360,14 @@ class GossipPeerRuntime(PeerRuntime):
         delays = cfg.faults.straggler_delays(rnd, self.peers)
         if delays is not None and delays[self.peer_id] > 0:
             time.sleep(float(delays[self.peer_id]))
+        # limp lane (gray failures, ROBUSTNESS.md §11): same real train-
+        # seam stall as the leadered path — never sampled, the soak
+        # gates count stalls exactly
+        limp_act = cfg.faults.limp_action(rnd, self.peer_id)
+        if limp_act is not None and limp_act["stall_s"] > 0:
+            telemetry.emit("limp.inject", kind="stall", round=int(rnd),
+                           stall_s=float(limp_act["stall_s"]))
+            time.sleep(float(limp_act["stall_s"]))
 
         self._state_np = jax.tree.map(np.asarray,
                                       jax.device_get(self.trainable))
@@ -333,10 +375,24 @@ class GossipPeerRuntime(PeerRuntime):
         nbrs = sample_neighbors(cfg.seed, rnd, self.peer_id, live,
                                 cfg.dist.gossip_fanout,
                                 cfg.dist.gossip_topology)
+        # suspicion hedge (gossip_hedge_phi > 0, phi detector only): a
+        # sampled neighbor the estimator already suspects is redrawn
+        # from the healthy remainder BEFORE any bytes move — proportional
+        # degradation at the topology layer, seeded and replayable
+        hedged = ()
+        det = self.transport.detector
+        hedge_phi = float(cfg.dist.gossip_hedge_phi)
+        if nbrs and hedge_phi > 0 and hasattr(det, "phi"):
+            suspicion = {int(p): float(det.phi(int(p)))
+                         for p in live if int(p) != self.peer_id}
+            nbrs, hedged = hedge_neighbors(
+                cfg.seed, rnd, self.peer_id, live, nbrs, suspicion,
+                hedge_phi)
         telemetry.emit("gossip.exchange", round=int(rnd),
                        neighbors=list(nbrs), live=list(live),
                        fanout=int(cfg.dist.gossip_fanout),
                        topology=cfg.dist.gossip_topology,
+                       hedged=list(hedged),
                        vv=[int(x) for x in self.vv])
         header0 = {
             "type": "update", "round": int(rnd),
@@ -497,6 +553,7 @@ class GossipPeerRuntime(PeerRuntime):
             **({"chain_len": len(self.chain),
                 "head8": self.chain.head.hex()[:16], "rewrite": False}
                if self.chain is not None else {}))
+        self._observe_gray_health()
         if self.rep is not None:
             # the peer-local merge IS the observation clock (there is no
             # leader clock to borrow): drain detector evidence, fold the
@@ -754,7 +811,7 @@ class GossipPeerRuntime(PeerRuntime):
             try:
                 from bcfl_tpu.metrics.metrics import ResourceMonitor
 
-                self._resmon = ResourceMonitor()
+                self._resmon = ResourceMonitor(run_dir=self.run_dir)
                 self._resmon.start_sampling(
                     self.cfg.dist.resource_sample_s)
             except Exception as e:  # noqa: BLE001 — psutil absence never kills a peer
@@ -796,6 +853,13 @@ class GossipPeerRuntime(PeerRuntime):
                     self._gossip_merge()
                 else:
                     self._maybe_depart()
+        except DurabilityError as e:
+            # the ENOSPC/EMFILE ladder exhausted every remedy: the peer
+            # cannot persist state, so it leaves with the distinct
+            # un-durable exit code rather than limping on volatile-only
+            logger.error("peer %d un-durable: %s", self.peer_id, e)
+            self._write_report(status="undurable")
+            return DurabilityError.EXIT_CODE
         finally:
             self.transport.flush_sends(timeout_s=2.0)
             self.transport.close()

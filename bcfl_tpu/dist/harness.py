@@ -104,7 +104,8 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
              kill_after_version: int = 1,
              restart_delay_s: float = 2.0,
              restart_killed: bool = True,
-             churn: Optional[Dict] = None) -> Dict:
+             churn: Optional[Dict] = None,
+             limp: Optional[Dict] = None) -> Dict:
     """Run one full dist federation: spawn ``cfg.dist.peers`` peer
     processes, supervise them under a hard deadline, optionally SIGKILL
     ``kill_peer`` mid-run once its checkpoint has reached
@@ -141,6 +142,20 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
     so a scrub that finds nothing usable repairs over STATE_SYNC instead
     of exiting with ResumeError.EXIT_CODE.
 
+    ``limp`` drives supervised SIGSTOP/SIGCONT pause cycles of one peer —
+    the gray-failure limp lane (ROBUSTNESS.md §11): unlike a SIGKILL the
+    peer never dies and never resumes from checkpoint, it just goes
+    SILENT for ``pause_s`` seconds and then continues exactly where it
+    was — the canonical limping-process signature (GC stall, CPU
+    starvation, a VM freeze) that fixed-timeout detectors flap on. A
+    dict ``{"peer", "pause_s", "period_s", "cycles", "stop_after_s"}``:
+    every ``period_s`` seconds, while fewer than ``cycles`` pauses have
+    fired, peer 0 and the target are still alive, and (when
+    ``stop_after_s`` is set) only inside that window, the peer is
+    SIGSTOPped, left frozen ``pause_s``, and SIGCONTed. Cycle records
+    land under ``result["limp"]``. Composes freely with ``churn`` as
+    long as they target different peers.
+
     Returns ``{"ok", "returncodes", "reports", "run_dir", ...}``; raises
     nothing on peer failure — the caller inspects the result (and the logs
     under ``run_dir``)."""
@@ -161,9 +176,12 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
     killed_restarted = False
     kill_record = None
     churn_records: List[Dict] = []
+    limp_records: List[Dict] = []
     t0 = time.time()
     churn_next = (t0 + float(churn.get("period_s", 45.0))
                   if churn else None)
+    limp_next = (t0 + float(limp.get("period_s", 20.0))
+                 if limp else None)
     while time.time() - t0 < deadline_s:
         for p, proc in list(procs.items()):
             rc = proc.poll()
@@ -249,6 +267,32 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
                          **({"damage": damage} if damage else {})})
                     churn_next = (time.time()
                                   + float(churn.get("period_s", 45.0)))
+        if (limp_next is not None and time.time() >= limp_next
+                and len(limp_records) < int(limp.get("cycles", 3))
+                and rcs.get(0) is None
+                and rcs.get(int(limp["peer"])) is None):
+            lp = int(limp["peer"])
+            stop_after = limp.get("stop_after_s")
+            if (stop_after is not None
+                    and time.time() - t0 > float(stop_after)):
+                limp_next = None   # window closed: no further pauses
+            else:
+                proc = procs[lp]
+                pause_s = float(limp.get("pause_s", 3.0))
+                try:
+                    # freeze, not kill: the peer's sockets stay open and
+                    # its kernel buffers keep accepting — peers talking to
+                    # it see silence and backpressure, not a reset
+                    proc.send_signal(signal.SIGSTOP)
+                    time.sleep(pause_s)
+                finally:
+                    if proc.poll() is None:
+                        proc.send_signal(signal.SIGCONT)
+                limp_records.append(
+                    {"peer": lp, "cycle": len(limp_records) + 1,
+                     "paused_at_s": round(time.time() - t0 - pause_s, 3),
+                     "pause_s": pause_s})
+                limp_next = time.time() + float(limp.get("period_s", 20.0))
         if all(rc is not None for rc in rcs.values()):
             break
         time.sleep(0.25)
@@ -292,6 +336,7 @@ def run_dist(cfg, run_dir: str, deadline_s: Optional[float] = None,
         "log_tails": logs,
         "kill": kill_record,
         "churn": churn_records,
+        "limp": limp_records,
         "run_dir": run_dir,
         "event_streams": (find_streams(tele_dir)
                           if tele_dir is not None else []),

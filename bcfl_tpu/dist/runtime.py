@@ -79,6 +79,18 @@ class ResumeError(RuntimeError):
     EXIT_CODE = 8
 
 
+class DurabilityError(RuntimeError):
+    """A durable write (checkpoint commit / ledger high-water) kept
+    failing after every rung of the resource-lane response ladder
+    (emergency retention GC, then telemetry shed — ROBUSTNESS.md §11).
+    Distinct exit code so supervisors distinguish "this host cannot make
+    rounds durable" (disk full / fd table exhausted: an operator must
+    free resources) from every crash/stall/deadline failure mode — a
+    peer must never silently keep committing un-durable state."""
+
+    EXIT_CODE = 9
+
+
 @dataclasses.dataclass
 class MergeRecord:
     version: int
@@ -161,6 +173,7 @@ class PeerRuntime:
         import jax
 
         from bcfl_tpu.dist.transport import (
+            LimpChaos,
             PartitionGate,
             PeerTransport,
             WireChaos,
@@ -187,6 +200,16 @@ class PeerRuntime:
             telemetry.install(telemetry.EventWriter(
                 self.events_path, peer=self.peer_id, run=cfg.name,
                 sample=cfg.telemetry_sample))
+        # resource lane, events seam: the EventWriter's flush-time fault
+        # hook consults the seeded per-flush draw (the writer's own errno
+        # handler sheds sampled telemetry in response — the stream never
+        # takes down the run, so this seam never reaches the exit rung)
+        self._events_flush_n = 0
+        self._events_fault_busy = False
+        if cfg.faults.resource_enabled:
+            w = telemetry.get_writer()
+            if w is not None:
+                w.write_fault = self._events_write_fault
         k = cfg.num_clients // self.peers
         self.local_clients = k
         self.global_ids = np.arange(self.peer_id * k, (self.peer_id + 1) * k)
@@ -310,6 +333,11 @@ class PeerRuntime:
         # peer's local round); an all-defaults plan injects nothing
         chaos = (WireChaos(cfg.faults, clock_fn=lambda: self.local_round)
                  if cfg.faults.wire_enabled else None)
+        # the limp lane shares the same autonomous span clock: its
+        # direction-keyed link throttles are consumed inside the
+        # transport's attempt loop (a paced send, never a silent stall)
+        limp = (LimpChaos(cfg.faults, clock_fn=lambda: self.local_round)
+                if cfg.faults.limp_enabled else None)
         host = cfg.dist.host
         # transport incarnation epoch: a file-backed restart counter, NOT
         # wall clock — a backward clock step between a crash and its
@@ -326,7 +354,7 @@ class PeerRuntime:
         self.transport = PeerTransport(
             self.peer_id, [(host, p) for p in ports], gate=self.gate,
             io_timeout_s=min(60.0, cfg.dist.peer_deadline_s),
-            chaos=chaos, policy=cfg.dist, epoch=epoch)
+            chaos=chaos, limp=limp, policy=cfg.dist, epoch=epoch)
 
         self.ckpt_dir = os.path.join(run_dir, f"ckpt_peer{self.peer_id}")
         # monotone-incarnation high-water marker: like the transport epoch
@@ -520,6 +548,15 @@ class PeerRuntime:
         delays = cfg.faults.straggler_delays(rnd, self.peers)
         if delays is not None and delays[self.peer_id] > 0:
             time.sleep(float(delays[self.peer_id]))
+        # limp lane (gray failures, ROBUSTNESS.md §11): the CPU-starved/
+        # swapping case — a REAL stall at the train seam, so the phi
+        # detector and the w_slow response are graded against measured
+        # slowness. Never sampled: the soak gates count stalls exactly.
+        limp_act = cfg.faults.limp_action(rnd, self.peer_id)
+        if limp_act is not None and limp_act["stall_s"] > 0:
+            telemetry.emit("limp.inject", kind="stall", round=int(rnd),
+                           stall_s=float(limp_act["stall_s"]))
+            time.sleep(float(limp_act["stall_s"]))
 
         leader = self._leader()
         if self.byz is not None:
@@ -710,6 +747,9 @@ class PeerRuntime:
             **({"chain_len": len(self.chain),
                 "head8": self.chain.head.hex()[:16], "rewrite": False}
                if self.chain is not None else {}))
+        # gray-failure observation shares the merge clock whether or not
+        # reputation is armed: phi samples land in the stream either way
+        self._observe_gray_health()
         if self.rep is not None:
             # the merge IS the observation clock: fold the pending wire
             # evidence (auth/outlier/staleness/replay + drained detector
@@ -756,6 +796,54 @@ class PeerRuntime:
         for t in recent:
             if t.get("to") == _DOWN:
                 self.rep.note_detector_down(t["peer"])
+
+    def _observe_gray_health(self) -> None:
+        """Gray-failure observation, clocked by the merge (leadered) or
+        the peer-local merge (gossip): sample the phi detector's per-peer
+        suspicion into the stream and feed MEASURED slowness to the
+        reputation tracker's w_slow lane. Severity is the WORST of three
+        measurements, clamped to [0, 1]: phi normalized by the down
+        threshold (liveness suspicion — silence, failed sends); the
+        measured-throughput shortfall below ``min_bandwidth_bps`` (the
+        config's own "slowest link we budget for": a link the estimator
+        measures BELOW it is limping even when every adaptively-budgeted
+        send still lands); and the measured-RTT excess beyond
+        ``deadline_floor_s`` (a round trip consuming more than the
+        fastest wall we would ever enforce — the stall/SIGSTOP signature:
+        acks come back seconds late while throughput and phi both look
+        healthy at the merge instant). All three are zero for a healthy
+        peer, which is what lets the down-weight RECOVER when the limp
+        clears.
+        Structurally a down-weight only: ``note_slowness`` never touches
+        the quarantine evidence path (the ``slowness_is_not_malice``
+        invariant holds by construction, then gets checked anyway)."""
+        det = self.transport.detector
+        snap_fn = getattr(det, "phi_snapshot", None)
+        if snap_fn is None:
+            return  # detector="fixed": no continuous suspicion to sample
+        phi_down = float(self.cfg.dist.phi_down)
+        for key, info in snap_fn().items():
+            p = int(key)
+            if p == self.peer_id:
+                continue
+            telemetry.emit_sampled(
+                "detector.phi", (int(self.version), p), target=p,
+                phi=info["phi"], state=det.state_of(p),
+                window_s=info.get("window_s"), rtt_s=info.get("rtt_s"),
+                bps=info.get("bps"))
+            if self.rep is not None:
+                sev_phi = (min(float(info["phi"]) / phi_down, 1.0)
+                           if phi_down > 0 else 0.0)
+                bps = info.get("bps")
+                min_bps = float(self.cfg.dist.min_bandwidth_bps)
+                sev_bw = (max(0.0, 1.0 - float(bps) / min_bps)
+                          if bps and min_bps > 0 else 0.0)
+                rtt = info.get("rtt_s")
+                floor = float(self.cfg.dist.deadline_floor_s)
+                sev_rtt = (max(0.0, float(rtt) / floor - 1.0)
+                           if rtt and floor > 0 else 0.0)
+                self.rep.note_slowness(
+                    p, min(1.0, max(sev_phi, sev_bw, sev_rtt)))
 
     def _apply_robust_merge(self, updates: List[Dict]) -> Dict:
         """Robust twin of :meth:`_apply_merge`: each buffered update is
@@ -1447,6 +1535,103 @@ class PeerRuntime:
 
     # --------------------------------------------------- checkpoint / resume
 
+    def _durable_write(self, seam: str, counter: int, fn):
+        """One durable write through the resource-lane response ladder
+        (ROBUSTNESS.md §11). The seeded draw decides whether this write's
+        first ``depth`` attempts fail (ENOSPC/EMFILE raised cleanly,
+        nothing landed — the commit protocol is all-or-nothing, so a
+        retry is safe); each failure walks one rung — emergency retention
+        GC, then telemetry shed — before retrying. A write still failing
+        after every remedy raises :class:`DurabilityError`: the peer
+        exits with the distinct durability code instead of silently
+        committing un-durable state. A REAL (non-injected) ENOSPC/EMFILE
+        out of ``fn`` walks the same ladder."""
+        plan = self.cfg.faults
+        act = (plan.resource_action(seam, counter, self.peer_id)
+               if plan.resource_enabled else None)
+        remedies = 0
+        while True:
+            try:
+                if act is not None and remedies < act["depth"]:
+                    err = 28 if act["cls"] == "enospc" else 24
+                    telemetry.emit("resource.inject", seam=seam,
+                                   cls=act["cls"], counter=int(counter),
+                                   depth=int(act["depth"]),
+                                   attempt=remedies, errno=err)
+                    raise OSError(err, os.strerror(err))
+                return fn()
+            except OSError as e:
+                if e.errno not in (28, 24):
+                    raise
+                if remedies == 0:
+                    self._emergency_gc(seam)
+                elif remedies == 1:
+                    self._shed_telemetry(seam)
+                else:
+                    raise DurabilityError(
+                        f"peer {self.peer_id}: durable write at the "
+                        f"{seam!r} seam (counter {counter}) still failing "
+                        f"(errno {e.errno}) after emergency GC and "
+                        f"telemetry shed") from e
+                remedies += 1
+
+    def _emergency_gc(self, seam: str) -> None:
+        """First ladder rung: free space NOW by dropping every retained
+        checkpoint round except the newest — retention depth is a
+        convenience, durability of the CURRENT round is the contract.
+        The newest committed round always survives (the peer stays
+        restorable even if the retry still fails)."""
+        from bcfl_tpu.checkpoint.checkpoint import (
+            _fsync_dir,
+            _list_rounds,
+            _remove_round,
+        )
+
+        rounds = _list_rounds(self.ckpt_dir)
+        victims = rounds[:-1]
+        for r in victims:
+            _remove_round(self.ckpt_dir, r, keep_meta=False)
+        if victims:
+            _fsync_dir(self.ckpt_dir)
+        telemetry.emit("gc.emergency", seam=seam, removed=len(victims),
+                       kept=len(rounds) - len(victims))
+
+    def _shed_telemetry(self, seam: str) -> None:
+        """Second ladder rung: stop buffering SAMPLED telemetry (counted,
+        never written) so durable bytes get whatever headroom remains.
+        Never-sampled events keep flowing (the invariants read those) and
+        ledger/checkpoint bytes are never shed — only the high-rate
+        observability tail is."""
+        w = telemetry.get_writer()
+        if w is not None and w.begin_shed(seam):
+            telemetry.emit("write.shed", seam=seam, mode="on")
+
+    def _events_write_fault(self, nbytes: int) -> None:
+        """Resource lane at the EventWriter flush seam: consult the
+        seeded per-flush draw and fail the stream write cleanly. The
+        writer's own errno handler sheds sampled telemetry in response —
+        this seam never escalates to the exit rung (telemetry must never
+        take down the run it observes). The counter is the seam's own
+        flush sequence; the busy flag keeps the inject event's OWN flush
+        from recursing into a second draw."""
+        if self._events_fault_busy:
+            return
+        n = self._events_flush_n
+        self._events_flush_n += 1
+        act = self.cfg.faults.resource_action("events", n, self.peer_id)
+        if act is None:
+            return
+        err = 28 if act["cls"] == "enospc" else 24
+        self._events_fault_busy = True
+        try:
+            telemetry.emit("resource.inject", seam="events",
+                           cls=act["cls"], counter=n,
+                           depth=int(act["depth"]), errno=err,
+                           nbytes=int(nbytes))
+            raise OSError(err, os.strerror(err))
+        finally:
+            self._events_fault_busy = False
+
     def _maybe_checkpoint(self):
         cfg = self.cfg
         every = cfg.dist.checkpoint_every_versions
@@ -1474,11 +1659,20 @@ class PeerRuntime:
             # quarantine timer exactly where the crash left them
             state.update(self.rep.checkpoint_state())
         state.update(self._checkpoint_extra())
-        save_checkpoint(self.ckpt_dir, self.version, state,
-                        self.chain.to_json()
-                        if self.chain is not None else None,
-                        keep_last=cfg.dist.checkpoint_keep_last)
-        self._write_highwater()
+        # both durable seams run the resource-lane response ladder: the
+        # checkpoint commit (payload + meta sidecar carrying the chain
+        # bytes) and the ledger's durable commitment point (the
+        # high-water marker the rollback guard reads)
+        self._durable_write(
+            "checkpoint", self.version,
+            lambda: save_checkpoint(
+                self.ckpt_dir, self.version, state,
+                self.chain.to_json() if self.chain is not None else None,
+                keep_last=cfg.dist.checkpoint_keep_last))
+        self._durable_write(
+            "ledger",
+            len(self.chain) if self.chain is not None else self.version,
+            self._write_highwater)
         # storage fault lane (ROBUSTNESS.md §10): damage the committed
         # durable state per the seeded (peer, version) draw — injected
         # AFTER the commit, the media-failure model
@@ -1722,7 +1916,7 @@ class PeerRuntime:
             try:
                 from bcfl_tpu.metrics.metrics import ResourceMonitor
 
-                self._resmon = ResourceMonitor()
+                self._resmon = ResourceMonitor(run_dir=self.run_dir)
                 self._resmon.start_sampling(self.cfg.dist.resource_sample_s)
             except Exception as e:  # noqa: BLE001 — psutil absence never kills a peer
                 logger.warning("resource sampling unavailable: %s", e)
@@ -1783,6 +1977,13 @@ class PeerRuntime:
                     self._train_once()
                 else:
                     time.sleep(0.05)  # drained; waiting for shutdown/merges
+        except DurabilityError as e:
+            # the resource-lane exit rung: the host cannot make rounds
+            # durable even after GC + shed — exit with the distinct code,
+            # never silently commit un-durable state
+            logger.error("%s", e)
+            self._write_report(status="undurable")
+            return DurabilityError.EXIT_CODE
         finally:
             # a short drain so a follower's last enqueued update isn't cut
             # off mid-stream by close (post-shutdown frames are moot, but
@@ -1941,4 +2142,10 @@ def peer_main(argv=None) -> int:
         # authorized" is an operator decision, not a crash
         logger.error("%s", e)
         return ResumeError.EXIT_CODE
-    return rt.run()
+    try:
+        return rt.run()
+    except DurabilityError as e:
+        # backstop for a durable write failing outside the main loop —
+        # the same distinct "cannot make rounds durable" code
+        logger.error("%s", e)
+        return DurabilityError.EXIT_CODE

@@ -37,12 +37,19 @@ stay single-purpose):
   what makes the runtime's UPDATE merge / MODEL adopt / HELLO / reconcile
   handlers provably idempotent under this transport.
 - **Failure detection**: every attempt outcome feeds a per-peer circuit
-  breaker (:class:`FailureDetector`): consecutive failures move a peer
-  REACHABLE -> SUSPECT -> DOWN; while DOWN the circuit is open and sends
-  are skipped except one probe per interval, so a dead peer costs ~zero
-  per message and a recovered one is re-detected within a probe interval.
-  The detector's states and transition log ride the peer report — the
-  evidence vocabulary quorum degradation and quarantine consume.
+  breaker: consecutive failures move a peer REACHABLE -> SUSPECT -> DOWN;
+  while DOWN the circuit is open and sends are skipped except one probe
+  per interval, so a dead peer costs ~zero per message and a recovered
+  one is re-detected within a probe interval. The detector's states and
+  transition log ride the peer report — the evidence vocabulary quorum
+  degradation and quarantine consume. Two implementations share that
+  contract (``DistConfig.detector``): the adaptive phi-accrual-style
+  estimator (:class:`PhiFailureDetector`, the default — continuous
+  suspicion from failure pressure + silence beyond a learned per-peer
+  window, plus per-destination send deadlines scaled by measured RTT /
+  throughput and frame size) and the fixed consecutive counter
+  (:class:`FailureDetector`, ``detector="fixed"`` — bit-compatible with
+  pre-gray-failure replays).
 
 The **partition gate** is the FaultPlan partition lane driven at the socket
 level: a callable consulted on BOTH ends of every message — the sender
@@ -194,6 +201,231 @@ class FailureDetector:
             return False
 
 
+class PhiFailureDetector(FailureDetector):
+    """Adaptive phi-accrual-style failure detector (``detector="phi"``,
+    RUNTIME.md "Timing contract"; after Hayashibara et al.'s phi-accrual
+    design, adapted to bursty request/response traffic).
+
+    Same public surface, state vocabulary, and transition/telemetry
+    contract as the fixed counter, but suspicion is a CONTINUOUS per-peer
+    level::
+
+        phi(p) = consecutive_failures(p)
+                 + max(0, silence(p) / window(p) - 1)
+
+    where ``silence`` is the time since the last liveness evidence
+    (successful send to, or CRC-valid inbound frame from, the peer) and
+    ``window`` is the learned expected silence — EWMA mean + 3 sigma of
+    the peer's inbound intervals, clamped to [window_floor_s,
+    window_ceil_s] (the ceiling is also the prior before any sample, so
+    an unheard-from peer accrues slowly instead of flapping at startup).
+    phi is monotone between evidence — silence only grows it — and any
+    liveness evidence snaps it back to 0 (REACHABLE). ``phi_suspect`` /
+    ``phi_down`` replace ``suspect_after`` / ``down_after``: under pure
+    send failures the defaults grade identically (1 phi unit per
+    consecutive failure), while a peer that is merely SILENT — the
+    SIGSTOP'd, swapping, or one-way-degraded gray failure — also accrues,
+    which the fixed counter is structurally blind to.
+
+    The estimator additionally learns per-destination RTT (EWMA +
+    variance over per-attempt success wall times) and throughput (bytes/s
+    over large frames), from which :meth:`send_budget_s` derives the
+    ADAPTIVE per-destination send deadline: RTT headroom plus the frame's
+    expected wire time at the measured (or assumed-minimum) throughput,
+    clamped to [deadline_floor_s, deadline_ceil_s]. That is the
+    large-frame starvation fix: a 32 MB frame on a slow link earns a
+    size-proportional budget instead of starving under a latency-tuned
+    constant.
+
+    Estimates are measurements of the live run (wall clock in, wall
+    clock out) — nothing here is part of the seeded-determinism scope;
+    the seeded lanes INJECT slowness, this class measures it."""
+
+    _ALPHA = 0.2   # EWMA weight for the interval/RTT/throughput estimates
+    _THROUGHPUT_MIN_BYTES = 65536   # frames below this measure latency,
+    # not bandwidth — keep them out of the throughput estimate
+
+    def __init__(self, peers: int, phi_suspect: float = 2.0,
+                 phi_down: float = 6.0, probe_interval_s: float = 2.0,
+                 window_floor_s: float = 5.0, window_ceil_s: float = 120.0,
+                 deadline_floor_s: float = 2.0,
+                 deadline_ceil_s: float = 120.0,
+                 min_bandwidth_bps: float = 1_048_576.0,
+                 base_deadline_s: float = 20.0):
+        super().__init__(peers, probe_interval_s=probe_interval_s)
+        self.phi_suspect = float(phi_suspect)
+        self.phi_down = float(phi_down)
+        self.window_floor_s = float(window_floor_s)
+        self.window_ceil_s = float(window_ceil_s)
+        self.deadline_floor_s = float(deadline_floor_s)
+        self.deadline_ceil_s = float(deadline_ceil_s)
+        self.min_bandwidth_bps = float(min_bandwidth_bps)
+        self.base_deadline_s = float(base_deadline_s)
+        n = int(peers)
+        now = time.monotonic()
+        # all estimator state is guarded-by: _lock (inherited)
+        self._last = {p: now for p in range(n)}        # guarded-by: _lock
+        self._int_mean: Dict[int, Optional[float]] = \
+            {p: None for p in range(n)}                # guarded-by: _lock
+        self._int_var = {p: 0.0 for p in range(n)}     # guarded-by: _lock
+        self._rtt_mean: Dict[int, Optional[float]] = \
+            {p: None for p in range(n)}                # guarded-by: _lock
+        self._rtt_var = {p: 0.0 for p in range(n)}     # guarded-by: _lock
+        self._thr_mean: Dict[int, Optional[float]] = \
+            {p: None for p in range(n)}                # guarded-by: _lock
+
+    # --------------------------------------------------- evidence intake
+
+    def _heard(self, peer: int) -> None:  # guarded-by: _lock
+        """Liveness evidence: fold the silence gap into the interval
+        estimate, reset the silence clock and failure pressure, snap the
+        state shut."""
+        now = time.monotonic()
+        gap = now - self._last[peer]
+        self._last[peer] = now
+        m = self._int_mean[peer]
+        if m is None:
+            self._int_mean[peer] = gap
+        else:
+            d = gap - m
+            self._int_mean[peer] = m + self._ALPHA * d
+            self._int_var[peer] = ((1.0 - self._ALPHA)
+                                   * (self._int_var[peer]
+                                      + self._ALPHA * d * d))
+        self._fails[peer] = 0
+        self._set(peer, REACHABLE)
+
+    def on_success(self, peer: int) -> None:
+        with self._lock:
+            self._heard(peer)
+
+    def on_inbound(self, peer: int) -> None:
+        with self._lock:
+            if peer not in self._state:
+                return
+            self._heard(peer)
+
+    def on_failure(self, peer: int) -> None:
+        with self._lock:
+            self._fails[peer] += 1
+            self._refresh(peer)
+
+    def note_rtt(self, peer: int, rtt_s: float, nbytes: int = 0) -> None:
+        """One successful attempt's wall time (and frame size) feeds the
+        per-destination RTT / throughput estimates the adaptive send
+        deadline is derived from."""
+        with self._lock:
+            rtt_s = float(rtt_s)
+            m = self._rtt_mean[peer]
+            if m is None:
+                self._rtt_mean[peer] = rtt_s
+            else:
+                d = rtt_s - m
+                self._rtt_mean[peer] = m + self._ALPHA * d
+                self._rtt_var[peer] = ((1.0 - self._ALPHA)
+                                       * (self._rtt_var[peer]
+                                          + self._ALPHA * d * d))
+            if nbytes >= self._THROUGHPUT_MIN_BYTES and rtt_s > 0:
+                bps = nbytes / rtt_s
+                t = self._thr_mean[peer]
+                self._thr_mean[peer] = (bps if t is None
+                                        else t + self._ALPHA * (bps - t))
+
+    # ------------------------------------------------------- suspicion
+
+    def _window_s(self, peer: int) -> float:  # guarded-by: _lock
+        m = self._int_mean[peer]
+        if m is None:
+            return self.window_ceil_s
+        w = m + 3.0 * self._int_var[peer] ** 0.5
+        return min(max(w, self.window_floor_s), self.window_ceil_s)
+
+    def _phi_locked(self, peer: int) -> float:  # guarded-by: _lock
+        silence = time.monotonic() - self._last[peer]
+        return (float(self._fails[peer])
+                + max(0.0, silence / self._window_s(peer) - 1.0))
+
+    def _refresh(self, peer: int) -> None:  # guarded-by: _lock
+        """Map the continuous phi onto the shared state vocabulary. phi
+        never decreases between evidence (silence only grows, failures
+        only accumulate), so thresholds only ever move the state UP here;
+        the snap back down is _heard's job."""
+        ph = self._phi_locked(peer)
+        if ph >= self.phi_down:
+            self._set(peer, DOWN)
+        elif ph >= self.phi_suspect:
+            self._set(peer, SUSPECT)
+
+    def phi(self, peer: int) -> float:
+        """The peer's current suspicion level (refreshes its state)."""
+        with self._lock:
+            self._refresh(peer)
+            return self._phi_locked(peer)
+
+    def state_of(self, peer: int) -> str:
+        with self._lock:
+            self._refresh(peer)
+            return self._state[peer]
+
+    def states(self) -> Dict[int, str]:
+        with self._lock:
+            for p in self._state:
+                self._refresh(p)
+            return dict(self._state)
+
+    def allow(self, peer: int) -> bool:
+        with self._lock:
+            self._refresh(peer)
+            if self._state[peer] != DOWN:
+                return True
+            now = time.monotonic()
+            if now - self._last_probe[peer] >= self.probe_interval_s:
+                self._last_probe[peer] = now
+                return True
+            return False
+
+    # ------------------------------------------------ adaptive deadline
+
+    def send_budget_s(self, peer: int, nbytes: int) -> float:
+        """Adaptive per-destination send deadline: measured RTT headroom
+        (mean + 4 sigma) plus the frame's expected wire time at the
+        destination's measured throughput (halved for safety margin; the
+        configured minimum-bandwidth assumption stands in before any
+        measurement), clamped to [deadline_floor_s, deadline_ceil_s].
+        Before any RTT sample the static base deadline is the headroom —
+        first contact is never MORE aggressive than the fixed policy."""
+        with self._lock:
+            m = self._rtt_mean[peer]
+            if m is None:
+                base = self.base_deadline_s
+            else:
+                base = m + 4.0 * self._rtt_var[peer] ** 0.5
+            thr = self._thr_mean[peer]
+            if thr is None or thr <= 0:
+                bps = self.min_bandwidth_bps
+            else:
+                bps = max(0.5 * thr, 1.0)
+            budget = base + float(nbytes) / bps
+            return min(max(budget, self.deadline_floor_s),
+                       self.deadline_ceil_s)
+
+    def phi_snapshot(self) -> Dict[str, Dict]:
+        """Per-peer estimator snapshot for the report/telemetry rollup."""
+        with self._lock:
+            out = {}
+            for p in self._state:
+                self._refresh(p)
+                out[str(p)] = {
+                    "phi": round(self._phi_locked(p), 4),
+                    "window_s": round(self._window_s(p), 4),
+                    "rtt_s": (round(self._rtt_mean[p], 6)
+                              if self._rtt_mean[p] is not None else None),
+                    "bps": (round(self._thr_mean[p], 1)
+                            if self._thr_mean[p] is not None else None),
+                }
+            return out
+
+
 class PartitionGate:
     """FaultPlan partition lane, evaluated over PEER ids at the socket.
 
@@ -258,6 +490,29 @@ class WireChaos:
         return self.plan.wire_actions(c, src, dst, msg_id, attempt)
 
 
+class LimpChaos:
+    """FaultPlan limp lane's THROTTLE seam bound to one sender: draws the
+    direction-keyed link byte rate with the peer's local round as the
+    lane clock (the same clock discipline as :class:`WireChaos` — the
+    clock is pinned at enqueue time on the pipelined path, so a frame's
+    fate is a deterministic function of the round that produced it). The
+    draw degrades a DIRECTION: (src, dst) and (dst, src) draw
+    independently, which is what makes one-way gray failures — A→B limps
+    while B→A answers fine — injectable and replayable."""
+
+    def __init__(self, plan: Optional[FaultPlan],
+                 clock_fn: Callable[[], int]):
+        self.plan = plan if plan is not None else FaultPlan()
+        self.clock_fn = clock_fn
+
+    def throttle_bps(self, src: int, dst: int,
+                     clock: Optional[int] = None) -> Optional[float]:
+        """Byte rate the src→dst direction is degraded to this round, or
+        None when the direction is healthy / the lane is off."""
+        c = int(self.clock_fn()) if clock is None else int(clock)
+        return self.plan.limp_throttle(c, src, dst)
+
+
 class PeerTransport:
     """Frame transport bound to one peer id.
 
@@ -273,19 +528,34 @@ class PeerTransport:
                  io_timeout_s: float = 60.0,
                  chaos: Optional[WireChaos] = None,
                  policy: Optional[DistConfig] = None,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 limp: Optional[LimpChaos] = None):
         self.peer_id = int(peer_id)
         self.addrs = list(addrs)
         self.gate = gate
         self.chaos = chaos
+        self.limp = limp
         self.policy = policy if policy is not None else DistConfig()
         self.connect_timeout_s = connect_timeout_s
         self.io_timeout_s = io_timeout_s
         self.inbox: "queue.Queue" = queue.Queue(
             maxsize=self.policy.inbox_max)
-        self.detector = FailureDetector(
-            len(addrs), self.policy.suspect_after, self.policy.down_after,
-            self.policy.probe_interval_s)
+        if self.policy.detector == "phi":
+            self.detector: FailureDetector = PhiFailureDetector(
+                len(addrs),
+                phi_suspect=self.policy.phi_suspect,
+                phi_down=self.policy.phi_down,
+                probe_interval_s=self.policy.probe_interval_s,
+                window_floor_s=self.policy.phi_window_floor_s,
+                window_ceil_s=self.policy.phi_window_ceil_s,
+                deadline_floor_s=self.policy.deadline_floor_s,
+                deadline_ceil_s=self.policy.deadline_ceil_s,
+                min_bandwidth_bps=self.policy.min_bandwidth_bps,
+                base_deadline_s=self.policy.send_deadline_s)
+        else:
+            self.detector = FailureDetector(
+                len(addrs), self.policy.suspect_after,
+                self.policy.down_after, self.policy.probe_interval_s)
         # receive-path counters are bumped from concurrent per-connection
         # serve threads AND (with the pipeline on) the sender workers: a
         # plain += is a racy read-add-store there. Writes go through
@@ -302,6 +572,7 @@ class PeerTransport:
         self.reorders_held = 0      # guarded-by: _stats_lock (writes) — chaos holds
         self.circuit_skips = 0      # guarded-by: _stats_lock (writes) — open-circuit skips
         self.dropped_by_gate = 0    # guarded-by: _stats_lock (writes) — partition drops
+        self.limp_paced = 0         # guarded-by: _stats_lock (writes) — limp throttle pacings
         self.chaos_injected = {"drop": 0, "dup": 0, "reorder": 0,  # guarded-by: _stats_lock (writes)
                                "delay": 0, "corrupt": 0}
         # the sender's incarnation epoch: part of the dedup identity, so a
@@ -737,7 +1008,25 @@ class PeerTransport:
         state = self.detector.state_of(to)
         probe = state == DOWN
         pol = self.policy
-        budget_s = timeout_s if timeout_s is not None else pol.send_deadline_s
+        # CRC ONCE per logical send: the prefix pass walks the leaf
+        # buffers zero-copy; re-attempts of an unchanged frame (the common
+        # case — only chaos reorder mutates the header) reuse it instead
+        # of re-checksumming a potentially multi-hundred-MB tree. The
+        # frame itself is never materialized — attempts stream straight
+        # from the numpy buffers (wire.write_frame). Computed BEFORE the
+        # budget: the adaptive deadline scales with the frame size.
+        prefix = frame_prefix(header, trees)
+        nbytes = len(prefix) + int.from_bytes(prefix[4:12], "little")
+        if timeout_s is not None:
+            budget_s = timeout_s
+        else:
+            # detector="phi": per-destination deadline from measured RTT /
+            # throughput, proportional to THIS frame's size (the
+            # large-frame starvation fix — RUNTIME.md "Timing contract");
+            # detector="fixed" keeps the static policy deadline verbatim
+            adapt = getattr(self.detector, "send_budget_s", None)
+            budget_s = (adapt(to, nbytes) if adapt is not None
+                        else pol.send_deadline_s)
         if probe:
             # bound the probe: a single cheap ping under a probe-interval
             # budget, never the full send deadline inline in the peer
@@ -747,28 +1036,34 @@ class PeerTransport:
             # on a slow link would flap SUSPECT->DOWN->REACHABLE forever
             # while only tiny pings get through). The cost: a
             # black-holing destination can freeze the loop for up to
-            # send_deadline_s per send during the bounded SUSPECT
+            # the send budget per send during the bounded SUSPECT
             # transient (at most ~down_after failed attempts) before the
-            # circuit opens — tune send_deadline_s/down_after for the
-            # link, the transient is bounded, starvation would not be
+            # circuit opens — the transient is bounded, starvation would
+            # not be (and under detector="phi" the budget itself adapts
+            # to the link)
             budget_s = min(budget_s, pol.probe_interval_s)
         deadline = time.monotonic() + budget_s
-        # CRC ONCE per logical send: the prefix pass walks the leaf
-        # buffers zero-copy; re-attempts of an unchanged frame (the common
-        # case — only chaos reorder mutates the header) reuse it instead
-        # of re-checksumming a potentially multi-hundred-MB tree. The
-        # frame itself is never materialized — attempts stream straight
-        # from the numpy buffers (wire.write_frame).
-        prefix = frame_prefix(header, trees)
-        nbytes = len(prefix) + int.from_bytes(prefix[4:12], "little")
+        # limp lane: direction-keyed throttle, drawn ONCE per logical send
+        # (the draw is round-keyed, so per-attempt re-draws would be
+        # identical anyway) on the same pinned clock as the wire lane
+        limp_bps = (self.limp.throttle_bps(self.peer_id, to,
+                                           clock=chaos_clock)
+                    if self.limp is not None else None)
         attempt = 0
         while True:
             acts = (self.chaos.actions(self.peer_id, to, msg_id, attempt,
                                        clock=chaos_clock)
                     if self.chaos is not None else None)
+            t_att = time.monotonic()
             try:
-                self._attempt(to, header, trees, prefix, acts, deadline)
+                self._attempt(to, header, trees, prefix, acts, deadline,
+                              limp_bps=limp_bps)
                 self.detector.on_success(to)
+                note = getattr(self.detector, "note_rtt", None)
+                if note is not None:
+                    # per-attempt success wall (pacing included — an
+                    # injected-slow link IS a slow link to the estimator)
+                    note(to, time.monotonic() - t_att, nbytes)
                 # stamped with the send's START instant (t_wall=t_start):
                 # the causal timeline needs the send to precede the recv
                 # it caused, and emission happens only after the ack
@@ -825,12 +1120,12 @@ class PeerTransport:
 
     def _attempt(self, to: int, header: Dict, trees: Optional[Dict],
                  prefix: bytes, acts: Optional[dict],
-                 deadline: float) -> None:
-        """One transmission attempt: chaos injection, connect, stream the
-        frame, ack. ``prefix`` is the pre-computed clean frame prefix
-        (magic + length + CRC); only the chaos reorder path (header
-        mutation) recomputes it. Raises :class:`TransportError` on any
-        failure."""
+                 deadline: float, limp_bps: Optional[float] = None) -> None:
+        """One transmission attempt: chaos injection, limp pacing,
+        connect, stream the frame, ack. ``prefix`` is the pre-computed
+        clean frame prefix (magic + length + CRC); only the chaos reorder
+        path (header mutation) recomputes it. Raises
+        :class:`TransportError` on any failure."""
         def _chaos(action: str, **extra) -> None:
             # per-injection events: high-rate under an armed lane, so
             # routed through the sampling knob; the lane/draw/target
@@ -862,6 +1157,22 @@ class PeerTransport:
             raise TransportError(
                 f"chaos wire lane dropped msg {header['msg_id']} "
                 f"-> peer {to}")
+        if limp_bps is not None and limp_bps > 0:
+            # limp lane throttle: pace the attempt by the frame's wire
+            # time at the degraded rate, bounded by the remaining budget
+            # (an over-throttled frame runs out of budget in _deliver and
+            # fails VISIBLY — the detector/w_slow evidence path, never a
+            # silent stall past the deadline)
+            nbytes = len(prefix) + int.from_bytes(prefix[4:12], "little")
+            pace_s = min(nbytes / limp_bps,
+                         max(deadline - time.monotonic(), 0.0))
+            if pace_s > 0:
+                self._bump("limp_paced")
+                _telemetry.emit_sampled(
+                    "limp.inject", (to, header.get("msg_id"), "throttle"),
+                    kind="throttle", dst=to, msg_id=header.get("msg_id"),
+                    bps=limp_bps, pace_s=round(pace_s, 4))
+                time.sleep(pace_s)
         self._deliver(to, header, trees, prefix, corrupt, deadline)
         if acts is not None and acts["dup"]:
             # a duplicated delivery: second CLEAN copy of the same frame,
@@ -915,6 +1226,7 @@ class PeerTransport:
             "reorders_held": self.reorders_held,
             "circuit_skips": self.circuit_skips,
             "dropped_by_gate": self.dropped_by_gate,
+            "limp_paced": self.limp_paced,
             "pipeline": {
                 "async_enqueued": self.async_enqueued,
                 "backpressure_blocks": self.backpressure_blocks,
@@ -928,5 +1240,8 @@ class PeerTransport:
                 "states": {str(p): s
                            for p, s in self.detector.states().items()},
                 "transitions": list(self.detector.transitions),
+                **({"phi": self.detector.phi_snapshot()}
+                   if isinstance(self.detector, PhiFailureDetector)
+                   else {}),
             },
         }

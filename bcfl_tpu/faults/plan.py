@@ -87,7 +87,29 @@ into one seeded, deterministic, config-level schedule:
   listed (server, requester) pair serves, proving the receiver-side
   refingerprint refuses unauthenticated state. The local engine has no
   per-peer durable state to damage, so the capability table rejects the
-  lane on ``runtime="local"``.
+  lane on ``runtime="local"``,
+- **limp** — gray failures for the dist runtime (``runtime="dist"``
+  only; ROBUSTNESS.md §11 "Gray-failure adversary model"): peers that
+  are SLOW BUT ALIVE, the failure mode binary crash detectors either
+  miss or flap on. Per ``(peer, round)`` draw a limping peer stalls its
+  train step (injected sleep at the train seam, beside the straggler
+  sleep) and its links degrade to ``limp_throttle_bps`` — throttle
+  draws are DIRECTION-keyed (A→B can limp while B→A stays healthy;
+  ``limp_oneway`` restricts to the limp peer's outbound side).
+  Supervisor-driven SIGSTOP/SIGCONT pauses ride the harness
+  (``run_dist(limp=...)``), same split as churn. The proportional
+  response — phi-accrual suspicion, adaptive deadlines, w_slow
+  down-weighting that can never quarantine — is what this lane grades,
+- **resource** — durable-write failures for the dist runtime
+  (``runtime="dist"`` only; ROBUSTNESS.md §11): ENOSPC/EMFILE drawn per
+  ``(seam, write-counter, peer)`` at the moment a durable write is
+  attempted (checkpoint commit, ledger append, EventWriter flush — see
+  :data:`RESOURCE_SEAMS`). The runtime's response ladder — emergency
+  retention GC, then telemetry shed (sampled events first, never
+  ledger/checkpoint bytes), then a distinct exit code when a round
+  cannot be made durable — is what this lane grades. Unlike lane 8
+  (storage) this lane never damages bytes at rest: the write FAILS
+  cleanly and the process stays alive to respond.
 
 Everything is derived from ``(seed, fault lane, round)`` via
 ``np.random.default_rng`` — two engines with equal plans draw identical
@@ -127,6 +149,8 @@ _LANE_FLAKY = 5
 _LANE_WIRE = 6
 _LANE_BYZ = 7
 _LANE_STORAGE = 8
+_LANE_LIMP = 9
+_LANE_RESOURCE = 10
 
 # the byzantine lane's behavior vocabulary (ROBUSTNESS.md §8): every name a
 # plan may draw, in the canonical order the seeded choice indexes into
@@ -151,6 +175,23 @@ BYZ_BEHAVIORS = ("scale", "sign_flip", "garbage", "replay", "digest_forge",
 #                  repair catch it).
 STORAGE_CLASSES = ("torn", "payload_flip", "meta_flip", "truncate",
                    "delete", "ledger", "rollback")
+
+# the resource lane's failure-class vocabulary (ROBUSTNESS.md §11): every
+# class a plan may draw, in the canonical order the seeded choice indexes
+# into. Each names one way a durable write FAILS while the process stays
+# alive (the lane never damages bytes at rest — lane 8 owns that):
+#   enospc — the filesystem is full: the write raises ENOSPC with nothing
+#            landed (all-or-nothing; the runtime's GC → shed → exit
+#            ladder owns the response),
+#   emfile — the fd table is exhausted: the open raises EMFILE before any
+#            byte is written (same ladder; the GC step frees handles too).
+RESOURCE_CLASSES = ("enospc", "emfile")
+
+# the resource lane's seam vocabulary: every durable-write seam the dist
+# runtime consults the lane at, in canonical index order (the index keys
+# the seeded draw, so "checkpoint" draws never collide with "events"
+# draws at equal counters)
+RESOURCE_SEAMS = ("checkpoint", "ledger", "events")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -255,6 +296,36 @@ class FaultPlan:
     storage_delete_last: int = 1
     storage_rounds: Optional[Tuple[int, ...]] = None
     sync_tamper: Optional[Tuple[Tuple[int, int], ...]] = None
+    # limp lane (runtime="dist" only): gray failures — peers slow but
+    # alive. `limp_peers` bounds the victims (None = every peer); each
+    # limps per (peer, round) with `limp_prob`. A limp draw stalls the
+    # peer's train step `limp_stall_s` seconds (the CPU-starved/swapping
+    # case) and, when `limp_throttle_bps` > 0, degrades its links to that
+    # byte rate for the round. Throttle draws are DIRECTION-keyed — the
+    # (src, dst) and (dst, src) directions draw independently — and
+    # `limp_oneway` restricts eligibility to the limp peer's OUTBOUND
+    # direction (A→B limps while B→A stays healthy). `limp_rounds` bounds
+    # the lane to a span of the peer's local-round clock (None = every
+    # round). Supervisor-side SIGSTOP pauses are the harness's job
+    # (run_dist(limp=...)), not a plan draw — the same split as churn.
+    limp_peers: Optional[Tuple[int, ...]] = None
+    limp_prob: float = 0.0
+    limp_stall_s: float = 2.0
+    limp_throttle_bps: float = 0.0
+    limp_oneway: bool = False
+    limp_rounds: Optional[Tuple[int, ...]] = None
+    # resource lane (runtime="dist" only): durable-write failures drawn
+    # per (seam, counter, peer) at the moment a durable write is attempted
+    # (RESOURCE_SEAMS: checkpoint commit, ledger append, EventWriter
+    # flush). `resource_peers` bounds the victims (None = every peer),
+    # each write fails with `resource_prob`, the class drawn from
+    # `resource_classes` (a subset of RESOURCE_CLASSES), and
+    # `resource_rounds` bounds the lane to a span of the seam's own write
+    # counter (None = every write).
+    resource_peers: Optional[Tuple[int, ...]] = None
+    resource_prob: float = 0.0
+    resource_classes: Tuple[str, ...] = RESOURCE_CLASSES
+    resource_rounds: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         for name in ("dropout_prob", "straggler_prob", "corrupt_prob"):
@@ -482,6 +553,90 @@ class FaultPlan:
             if len(set(self.sync_tamper)) != len(self.sync_tamper):
                 raise ValueError(
                     f"sync_tamper lists a pair twice: {self.sync_tamper!r}")
+        # --- limp lane ---
+        if not 0.0 <= self.limp_prob <= 1.0:
+            raise ValueError(
+                f"limp_prob must be in [0, 1], got {self.limp_prob}")
+        if self.limp_peers is not None:
+            if not (isinstance(self.limp_peers, tuple)
+                    and all(isinstance(p, int) and p >= 0
+                            for p in self.limp_peers)):
+                raise ValueError(
+                    "limp_peers must be a tuple of non-negative peer ids "
+                    "(hashable — the plan lives inside the frozen "
+                    "FedConfig)")
+            if len(set(self.limp_peers)) != len(self.limp_peers):
+                raise ValueError(
+                    f"limp_peers lists a peer twice: {self.limp_peers!r}")
+            if self.limp_prob <= 0.0:
+                raise ValueError(
+                    "limp_peers with limp_prob=0 would silently never limp "
+                    "— the exact vacuous-pass this lane must not have")
+        for name in ("limp_stall_s", "limp_throttle_bps"):
+            v = getattr(self, name)
+            if v < 0 or not np.isfinite(v):
+                raise ValueError(f"{name} must be finite and >= 0, got {v}")
+        if (self.limp_prob > 0 and self.limp_stall_s <= 0
+                and self.limp_throttle_bps <= 0):
+            raise ValueError(
+                "limp_prob > 0 with limp_stall_s=0 and limp_throttle_bps=0 "
+                "injects nothing — the exact silent no-op this lane must "
+                "not have")
+        if self.limp_rounds is not None:
+            if not isinstance(self.limp_rounds, tuple):
+                raise ValueError("limp_rounds must be a tuple of round "
+                                 "indices (hashable — the plan lives inside "
+                                 "the frozen FedConfig)")
+            if not self.limp_rounds:
+                raise ValueError(
+                    "limp_rounds is empty: the limp lane would silently "
+                    "never fire (check the span bounds)")
+            if self.limp_prob <= 0.0:
+                raise ValueError(
+                    "limp_rounds without limp_prob > 0 would silently "
+                    "never limp a peer")
+        # --- resource lane ---
+        if not 0.0 <= self.resource_prob <= 1.0:
+            raise ValueError(
+                f"resource_prob must be in [0, 1], got {self.resource_prob}")
+        if self.resource_peers is not None:
+            if not (isinstance(self.resource_peers, tuple)
+                    and all(isinstance(p, int) and p >= 0
+                            for p in self.resource_peers)):
+                raise ValueError(
+                    "resource_peers must be a tuple of non-negative peer "
+                    "ids (hashable — the plan lives inside the frozen "
+                    "FedConfig)")
+            if len(set(self.resource_peers)) != len(self.resource_peers):
+                raise ValueError(
+                    f"resource_peers lists a peer twice: "
+                    f"{self.resource_peers!r}")
+            if self.resource_prob <= 0.0:
+                raise ValueError(
+                    "resource_peers with resource_prob=0 would silently "
+                    "never fail a write — the exact vacuous-pass this lane "
+                    "must not have")
+        if not (isinstance(self.resource_classes, tuple)
+                and self.resource_classes):
+            raise ValueError("resource_classes must be a non-empty tuple")
+        bad = [c for c in self.resource_classes if c not in RESOURCE_CLASSES]
+        if bad:
+            raise ValueError(
+                f"unknown resource failure classes {bad}; known: "
+                f"{RESOURCE_CLASSES}")
+        if self.resource_rounds is not None:
+            if not isinstance(self.resource_rounds, tuple):
+                raise ValueError("resource_rounds must be a tuple of write-"
+                                 "counter indices (hashable — the plan "
+                                 "lives inside the frozen FedConfig)")
+            if not self.resource_rounds:
+                raise ValueError(
+                    "resource_rounds is empty: the resource lane would "
+                    "silently never fire (check the span bounds)")
+            if self.resource_prob <= 0.0:
+                raise ValueError(
+                    "resource_rounds without resource_prob > 0 would "
+                    "silently never fail a durable write")
 
     # ------------------------------------------------------------------ query
 
@@ -491,7 +646,8 @@ class FaultPlan:
                 or self.corrupt_prob > 0 or self.crash_at_round is not None
                 or self.partitions or self.churns or self.flaky_enabled
                 or self.wire_enabled or self.byz_enabled
-                or self.storage_enabled)
+                or self.storage_enabled or self.limp_enabled
+                or self.resource_enabled)
 
     @property
     def wire_enabled(self) -> bool:
@@ -506,6 +662,14 @@ class FaultPlan:
     @property
     def storage_enabled(self) -> bool:
         return self.storage_prob > 0 or bool(self.sync_tamper)
+
+    @property
+    def limp_enabled(self) -> bool:
+        return self.limp_prob > 0
+
+    @property
+    def resource_enabled(self) -> bool:
+        return self.resource_prob > 0
 
     @property
     def partitions(self) -> bool:
@@ -774,6 +938,98 @@ class FaultPlan:
         rng = np.random.default_rng(
             (self.seed, _LANE_STORAGE, server, requester, 1))
         return {"frac": float(rng.random())}
+
+    def limp_action(self, rnd: int, peer: int) -> Optional[dict]:
+        """Gray-failure draw for ONE round of ``peer`` while its
+        local-round clock reads ``rnd`` (the same autonomous clock the
+        straggler and byzantine lanes use). Returns None when the peer
+        runs at full speed, else::
+
+            {"stall_s": <train-seam sleep, seconds>,
+             "throttle_bps": <link byte rate this round; 0 = unthrottled>}
+
+        Identical ``(seed, rnd, peer)`` coordinates always draw the
+        identical limp — replayable, so the unit tests pin determinism
+        and the soak can assert exactly which rounds limped. The stall is
+        injected at the train seam (beside the straggler sleep); the
+        throttle component is consumed per-direction via
+        :meth:`limp_throttle` (a round-level draw here, direction-level
+        draws there — a peer can limp without every link limping)."""
+        if not self.limp_enabled:
+            return None
+        if self.limp_peers is not None and peer not in self.limp_peers:
+            return None
+        if not self._due(self.limp_rounds, rnd):
+            return None
+        rng = np.random.default_rng((self.seed, _LANE_LIMP, rnd, peer))
+        if rng.random() >= self.limp_prob:
+            return None
+        return {"stall_s": float(self.limp_stall_s),
+                "throttle_bps": float(self.limp_throttle_bps)}
+
+    def limp_throttle(self, rnd: int, src: int, dst: int) -> Optional[float]:
+        """Direction-keyed link throttle for transmissions ``src -> dst``
+        while the sender's wire clock reads ``rnd``. Returns the byte
+        rate (bytes/s) the direction is degraded to, or None when it is
+        healthy. The draw is keyed by the ORDERED pair — (src, dst) and
+        (dst, src) draw independently, so A→B can limp while B→A stays
+        healthy — and with ``limp_oneway`` only the limp peer's OUTBOUND
+        direction is ever eligible (the asymmetric-link case one-way
+        gray failures exhibit)."""
+        if not self.limp_enabled or self.limp_throttle_bps <= 0:
+            return None
+        if not self._due(self.limp_rounds, rnd):
+            return None
+        if not (self._is_limp_peer(src)
+                or (not self.limp_oneway and self._is_limp_peer(dst))):
+            return None
+        rng = np.random.default_rng(
+            (self.seed, _LANE_LIMP, rnd, src, dst, 1))
+        if rng.random() >= self.limp_prob:
+            return None
+        return float(self.limp_throttle_bps)
+
+    def _is_limp_peer(self, peer: int) -> bool:
+        return self.limp_peers is None or peer in self.limp_peers
+
+    def resource_action(self, seam: str, counter: int,
+                        peer: int) -> Optional[dict]:
+        """Durable-write failure draw for ONE write attempt at ``seam``
+        (a :data:`RESOURCE_SEAMS` name) while that seam's write counter
+        reads ``counter`` (checkpoint: the version being committed;
+        ledger: the append index; events: the flush sequence). Returns
+        None when the write proceeds, else::
+
+            {"cls": <one of this plan's resource_classes>,
+             "depth": 1 | 2 | 3}
+
+        ``depth`` is how far up the response ladder the fault persists:
+        a depth-1 fault clears after emergency retention GC (the freed
+        space was enough), depth 2 clears only after telemetry shed, and
+        depth 3 survives every remedy — the peer must exit with the
+        durability code rather than silently commit un-durable state.
+
+        Identical ``(seed, seam, counter, peer)`` coordinates always draw
+        the identical failure — replayable, which is what lets the unit
+        tests pin the GC → shed → exit ladder against exact injection
+        points. The draw is consumed by the dist runtime BEFORE the write
+        lands: the lane models the write call failing cleanly (ENOSPC /
+        EMFILE), never bytes damaged at rest (lane 8 owns that)."""
+        if not self.resource_enabled:
+            return None
+        if (self.resource_peers is not None
+                and peer not in self.resource_peers):
+            return None
+        if not self._due(self.resource_rounds, counter):
+            return None
+        seam_idx = RESOURCE_SEAMS.index(seam)   # unknown seam fails loud
+        rng = np.random.default_rng(
+            (self.seed, _LANE_RESOURCE, seam_idx, counter, peer))
+        if rng.random() >= self.resource_prob:
+            return None
+        pick = int(rng.integers(len(self.resource_classes)))
+        depth = 1 + int(rng.integers(3))
+        return {"cls": self.resource_classes[pick], "depth": depth}
 
 
 class FaultInjector:

@@ -54,7 +54,7 @@ class ResourceMonitor:
     supported, but each later reading covers only the window since the
     previous snapshot — not the whole run."""
 
-    def __init__(self):
+    def __init__(self, run_dir: Optional[str] = None):
         import psutil
 
         self._proc = psutil.Process()
@@ -62,6 +62,22 @@ class ResourceMonitor:
         self._proc.cpu_percent(None)  # prime: first call is always 0.0
         self.rss_before = self._proc.memory_info().rss
         self.t_before = time.time()
+        # when set, sampling also reports free bytes on the filesystem
+        # holding the run directory — the resource fault lane's ENOSPC
+        # ladder (RUNTIME.md) is exactly the failure this series predicts
+        self._run_dir = run_dir
+
+    def disk_free_bytes(self) -> Optional[int]:
+        """Free bytes on the filesystem holding ``run_dir``, or None when
+        no run_dir was given or the statvfs fails (observer never raises)."""
+        if self._run_dir is None:
+            return None
+        try:
+            import shutil
+
+            return int(shutil.disk_usage(self._run_dir).free)
+        except OSError:
+            return None
 
     def snapshot(self) -> Dict[str, float]:
         return {
@@ -99,11 +115,15 @@ class ResourceMonitor:
             # snapshot()'s window would make both readings meaningless
             while not self._sample_stop.wait(interval_s):
                 try:
+                    free = self.disk_free_bytes()
+                    extra = ({} if free is None
+                             else {"disk_free_bytes": free,
+                                   "disk_free_gb": free / 1e9})
                     _telemetry.emit(
                         "resource",
                         rss_gb=self._proc.memory_info().rss / 1e9,
                         cpu_percent=self._proc.cpu_percent(None),
-                        interval_s=interval_s)
+                        interval_s=interval_s, **extra)
                 except Exception:  # noqa: BLE001 — observer never crashes the run
                     pass
 

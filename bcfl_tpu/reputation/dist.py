@@ -120,6 +120,7 @@ class DistReputationTracker:
     SRC_STALENESS = "staleness"
     SRC_REPLAY = "stale_replay"
     SRC_DETECTOR = "detector_down"
+    SRC_SLOWNESS = "slowness"
 
     def __init__(self, cfg: ReputationConfig, peers: int, self_id: int):
         self.cfg = cfg
@@ -127,6 +128,13 @@ class DistReputationTracker:
         self.self_id = int(self_id)
         self.tracker = ReputationTracker(cfg, peers, scope="peer")
         self._pending = np.zeros((self.peers,), np.float64)
+        # gray-failure lane (ROBUSTNESS.md §11): per-peer slowness EWMA in
+        # [0, 1], fed by the phi estimator's continuous suspicion. It is
+        # DELIBERATELY not part of ``_pending`` — slowness down-weights
+        # via :meth:`gate` but can never drive the state machine, so an
+        # honest-but-limping peer degrades proportionally instead of
+        # being quarantined (the slowness_is_not_malice invariant).
+        self._slow = np.zeros((self.peers,), np.float64)
         self.quarantine_drops = 0  # post-ack refusals of quarantined arrivals
 
     # ------------------------------------------------------------- evidence
@@ -173,6 +181,32 @@ class DistReputationTracker:
     def note_detector_down(self, peer: int) -> None:
         self._note(peer, self.SRC_DETECTOR, self.cfg.w_staleness)
 
+    def note_slowness(self, peer: int, severity: float) -> None:
+        """Fold one slowness observation (phi / phi_down, clipped to
+        [0, 1]) into the peer's slowness EWMA.
+
+        This bypasses :meth:`_note` and ``_pending`` ENTIRELY: slowness
+        evidence never reaches :meth:`observe_merge`, so it cannot move
+        the lifecycle state machine — it only scales :meth:`gate` by
+        ``1 - w_slow * slow``. Call it for EVERY peer at every merge
+        (severity 0.0 for the healthy ones): recovery is the zero
+        observations decaying the EWMA back down, the same clock in both
+        directions."""
+        p = int(peer)
+        if not 0 <= p < self.peers:
+            return
+        sev = float(np.clip(severity, 0.0, 1.0))
+        a = self.cfg.ewma_alpha
+        self._slow[p] = (1.0 - a) * self._slow[p] + a * sev
+        if sev > 0.0:
+            # same evidence stream as the malice lanes so the collator
+            # sees the full picture — but the slowness_is_not_malice
+            # invariant holds that rows with THIS source alone never
+            # precede a quarantine
+            _telemetry.emit("rep.dist_evidence", target=p,
+                            source=self.SRC_SLOWNESS, fault=sev,
+                            slow=round(float(self._slow[p]), 6))
+
     # -------------------------------------------------------------- observe
 
     def observe_merge(self, arrived: Sequence[int]
@@ -212,7 +246,12 @@ class DistReputationTracker:
         base = float(self.tracker.gate()[p])
         if base == 0.0:
             return 0.0
-        return base * float(np.clip(self.tracker.trust[p], 0.0, 1.0))
+        trust = float(np.clip(self.tracker.trust[p], 0.0, 1.0))
+        # gray-failure down-weight: w_slow < 1 keeps this strictly
+        # positive, so slowness alone can dim a vote but never silence it
+        slow_mult = 1.0 - self.cfg.w_slow * float(
+            np.clip(self._slow[p], 0.0, 1.0))
+        return base * trust * slow_mult
 
     def is_quarantined(self, peer: int) -> bool:
         return (0 <= int(peer) < self.peers
@@ -272,10 +311,14 @@ class DistReputationTracker:
     # ------------------------------------------------------ checkpoint/report
 
     def checkpoint_state(self) -> Dict[str, np.ndarray]:
-        return self.tracker.checkpoint_state()
+        out = self.tracker.checkpoint_state()
+        out["rep_slow"] = self._slow.copy()
+        return out
 
     def restore(self, state: Dict) -> None:
         self.tracker.restore(state)
+        if state.get("rep_slow") is not None:
+            self._slow = np.asarray(state["rep_slow"], np.float64).copy()
 
     def report(self) -> Dict:
         """Report block for report_peer*.json. Trust is serialized BOTH as
@@ -287,6 +330,8 @@ class DistReputationTracker:
             "state": self.tracker.state_names(),
             "trust": [round(float(t), 6) for t in self.tracker.trust],
             "trust_hex": [float(t).hex() for t in self.tracker.trust],
+            "slow": [round(float(s), 6) for s in self._slow],
+            "slow_hex": [float(s).hex() for s in self._slow],
             "timer": [int(t) for t in self.tracker.timer],
             "quarantine_events": self.tracker.quarantine_events.tolist(),
             "rounds_quarantined": self.tracker.rounds_quarantined.tolist(),

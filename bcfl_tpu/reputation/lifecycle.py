@@ -90,6 +90,15 @@ class ReputationConfig:
     w_anomaly: float = 0.5    # anomaly-filter flag (topology heuristic)
     w_staleness: float = 0.25  # async staleness beyond staleness_limit
     staleness_limit: int = 4  # 0 disables staleness evidence
+    # slowness DOWN-WEIGHT ceiling (dist only; reputation/dist.py). The
+    # phi estimator's continuous suspicion feeds a SEPARATE per-peer
+    # slowness EWMA that multiplies the merge gate by
+    # ``1 - w_slow * slow`` — it reduces a limping peer's vote but, by
+    # construction, can never move the lifecycle state machine: slowness
+    # is not malice (ROBUSTNESS.md §11). Must stay < 1 so the multiplier
+    # can never hit 0 — a fully-limping honest peer keeps a nonzero vote,
+    # which is what distinguishes degradation from exclusion.
+    w_slow: float = 0.5
     # chaos corruption hits are ground truth the simulation harness knows
     # because it injected them; counting them stands in for whatever local
     # detector a real deployment runs (with the ledger on they coincide
@@ -121,6 +130,9 @@ class ReputationConfig:
         if self.staleness_limit < 0:
             raise ValueError(
                 f"staleness_limit must be >= 0, got {self.staleness_limit}")
+        if not 0.0 <= self.w_slow < 1.0:
+            raise ValueError(
+                f"w_slow must be in [0, 1), got {self.w_slow}")
 
 
 class ReputationTracker:

@@ -116,6 +116,25 @@ EVENT_TYPES: Dict[str, tuple] = {
     # one adversarial injection: which behavior rewrote which outbound
     # update (the baseline legs gate on the total being exactly zero)
     "byz.inject": ("behavior",),
+    # --- gray-failure adversary (ROBUSTNESS.md §11) ---
+    # one injected limp action: kind = stall (train-seam sleep) |
+    # throttle (paced send) | pause (SIGSTOP/SIGCONT from the harness).
+    # stall is never sampled (the soak gates count them); throttle is
+    # sampled (per-attempt rate)
+    "limp.inject": ("kind",),
+    # one injected resource fault at a durable-write seam;
+    # seam: checkpoint | ledger | events; cls: enospc | emfile
+    "resource.inject": ("seam", "cls"),
+    # periodic phi-accrual suspicion sample (sampled — one per peer per
+    # evidence drain); extras carry window_s/rtt_s/state
+    "detector.phi": ("target", "phi"),
+    # telemetry shed toggled in response to a durable-write failure:
+    # SAMPLED events stop flowing (counted, not buffered) — ledger and
+    # checkpoint bytes are never shed. Never sampled itself.
+    "write.shed": ("seam", "mode"),
+    # emergency retention GC triggered by ENOSPC at a durable seam:
+    # oldest checkpoint rounds removed to free space. Never sampled.
+    "gc.emergency": ("seam", "removed"),
     # --- anomalies worth surfacing that are not failures ---
     # e.g. what="negative_staleness": a restarted leader's fresh version
     # counter sat below a sender's base version; the merge clamps the
@@ -190,7 +209,14 @@ class EventWriter:
         self._closed = False       # guarded-by: _lock
         self.emitted = 0           # guarded-by: _lock (writes)
         self.dropped = 0           # guarded-by: _lock (writes)
+        self.shed = 0              # guarded-by: _lock (writes) — shed sampled events
+        self.shedding = False      # guarded-by: _lock — telemetry-shed active
         self._warned: set = set()  # guarded-by: _lock — warned-once types
+        # optional fault seam (faults.plan resource lane): called with the
+        # pending byte count before each flush write; may raise OSError to
+        # model ENOSPC/EMFILE on the stream file. Installed by the dist
+        # runtime, None everywhere else.
+        self.write_fault = None
 
     # ------------------------------------------------------------------ emit
 
@@ -266,8 +292,27 @@ class EventWriter:
         return h < self.sample * 10_000
 
     def emit_sampled(self, ev: str, key, **fields) -> None:
+        with self._lock:
+            if self.shedding:
+                # telemetry-shed: sampled (high-rate) events are the FIRST
+                # thing dropped when the disk is failing writes — counted
+                # so the shed is visible in the final report, never
+                # buffered. Never-sampled events (emit) keep flowing: the
+                # invariants read those.
+                self.shed += 1
+                return
         if self.sampled(key):
             self.emit(ev, **fields)
+
+    def begin_shed(self, seam: str) -> bool:
+        """Turn on telemetry-shed (idempotent). Returns True if this call
+        flipped it, False if shedding was already active. The caller owns
+        emitting ``write.shed`` exactly when this returns True."""
+        with self._lock:
+            if self.shedding:
+                return False
+            self.shedding = True
+            return True
 
     # ----------------------------------------------------------------- flush
 
@@ -279,7 +324,11 @@ class EventWriter:
             # is ever written twice
             buf, self._buf = self._buf, []
             try:
-                self._f.write(b"".join(buf))
+                data = b"".join(buf)
+                fault = self.write_fault
+                if fault is not None:
+                    fault(len(data))  # may raise OSError (injected seam)
+                self._f.write(data)
                 self._f.flush()  # buffered write to the OS; no fsync
             except Exception as e:  # noqa: BLE001
                 # OSError (disk) — but ALSO RuntimeError: a signal
@@ -289,6 +338,17 @@ class EventWriter:
                 self.dropped += len(buf)
                 logger.warning("telemetry: flush to %s failed: %s",
                                self.path, e)
+                if isinstance(e, OSError) and e.errno in (28, 24):
+                    # ENOSPC/EMFILE on the stream: the disk this stream
+                    # shares with the ledger/checkpoints is failing
+                    # writes — shed sampled telemetry immediately so
+                    # durable bytes get whatever headroom remains.
+                    # RLock: the write.shed emit below re-enters fine;
+                    # it lands in the fresh buffer, never this one.
+                    if not self.shedding:
+                        self.shedding = True
+                        self.emit("write.shed", seam="events", mode="on",
+                                  errno=e.errno)
         self._last_flush = time.monotonic()
 
     def flush(self) -> None:

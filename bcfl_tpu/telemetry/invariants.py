@@ -358,6 +358,39 @@ def no_rollback_readmission(events: List[Dict]) -> List[Dict]:
     return out
 
 
+def slowness_is_not_malice(events: List[Dict]) -> List[Dict]:
+    # the gray-failure contract (ROBUSTNESS.md §11): slowness evidence
+    # (rep.dist_evidence source="slowness", the phi estimator's suspicion
+    # lane) down-weights but NEVER quarantines. So every peer-scoped
+    # quarantine decision must be preceded — in the deciding peer's own
+    # stream — by at least one dist-evidence row from a NON-slowness
+    # source about that target. from=="restored" re-declarations are
+    # exempt for the same reason as quarantine_evidence: the decision
+    # site lives in another incarnation's (or another peer's) stream.
+    malice: set = set()  # (stream peer, target) with non-slowness evidence
+    out = []
+    for e in events:
+        ev = e.get("ev")
+        if ev == "rep.dist_evidence":
+            if e.get("source") != "slowness":
+                malice.add((_peer_of(e), e.get("target")))
+        elif (ev == "rep.transition" and e.get("to") == "quarantined"
+                and e.get("scope") == "peer"
+                and e.get("from") != "restored"):
+            key = (_peer_of(e), e.get("client"))
+            if key not in malice:
+                out.append({
+                    "rule": "slowness_is_not_malice",
+                    "problem": "peer quarantined with no prior "
+                               "non-slowness dist evidence — an "
+                               "honest-slow peer was treated as "
+                               "malicious",
+                    "peer": _peer_of(e), "target": e.get("client"),
+                    "trust": e.get("trust"),
+                })
+    return out
+
+
 # name -> (check fn, one-line description); the collator and the trace CLI
 # walk this registry — adding a rule here adds it to every consumer
 INVARIANTS = {
@@ -389,6 +422,10 @@ INVARIANTS = {
         no_rollback_readmission,
         "no restarted peer persists below an earlier incarnation's "
         "committed chain high-water without repairing forward"),
+    "slowness_is_not_malice": (
+        slowness_is_not_malice,
+        "no peer-scoped quarantine rests on slowness evidence alone — "
+        "gray failure down-weights, it never excludes"),
 }
 
 

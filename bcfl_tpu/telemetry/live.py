@@ -493,11 +493,42 @@ class SNoRollbackReadmission(_StreamingCheck):
         return new
 
 
+class SSlownessIsNotMalice(_StreamingCheck):
+    name = "slowness_is_not_malice"
+
+    def __init__(self):
+        super().__init__()
+        # (stream peer, target) with non-slowness dist evidence seen
+        self._malice: set = set()
+
+    def feed(self, e: Dict) -> List[Dict]:
+        ev = e.get("ev")
+        if ev == "rep.dist_evidence":
+            if e.get("source") != "slowness":
+                self._malice.add((e.get("peer"), e.get("target")))
+            return []
+        if (ev == "rep.transition" and e.get("to") == "quarantined"
+                and e.get("scope") == "peer"
+                and e.get("from") != "restored"):
+            key = (e.get("peer"), e.get("client"))
+            if key not in self._malice:
+                v = {"rule": self.name,
+                     "problem": "peer quarantined with no prior "
+                                "non-slowness dist evidence — an "
+                                "honest-slow peer was treated as "
+                                "malicious",
+                     "peer": key[0], "target": key[1],
+                     "trust": e.get("trust")}
+                self.out.append(v)
+                return [v]
+        return []
+
+
 # registry mirrors invariants.INVARIANTS key-for-key (tested)
 STREAMING_CHECKS = {c.name: c for c in (
     SNoDoubleMerge, SAckedNotLost, SNoCrossPartitionMerge,
     SQuarantineEvidence, SMonotoneHeads, SNoQuarantinedMerge,
-    SRepairAuthenticated, SNoRollbackReadmission)}
+    SRepairAuthenticated, SNoRollbackReadmission, SSlownessIsNotMalice)}
 
 
 class StreamingInvariantSuite:
@@ -597,6 +628,12 @@ class AlertThresholds:
     trust_warn: float = 0.35              # per-peer trust floor
     rss_critical_gb: float = 24.0         # per-peer resident set
     corrupt_lines_warn: int = 1           # definite mid-stream damage
+    # free space on the filesystem holding the run dir (resource samples
+    # carry disk_free_gb when ResourceMonitor was given the run_dir) —
+    # the ENOSPC ladder's leading indicator. Warn early, critical when a
+    # checkpoint-sized write is plausibly about to fail.
+    disk_low_warn_gb: float = 2.0
+    disk_low_critical_gb: float = 0.5
 
 
 class AlertManager:
@@ -673,7 +710,8 @@ class HealthRollup:
         elif ev == "resource":
             self._resource[str(e.get("peer"))] = {
                 "rss_gb": e.get("rss_gb"),
-                "cpu_percent": e.get("cpu_percent")}
+                "cpu_percent": e.get("cpu_percent"),
+                "disk_free_gb": e.get("disk_free_gb")}
         elif ev == "rep.transition" and e.get("scope") == "peer":
             if e.get("trust") is not None:
                 try:
@@ -760,6 +798,13 @@ def evaluate_health_alerts(alerts: AlertManager, rec: Dict) -> List[Dict]:
         out.extend(alerts.set_state(
             "rss_high", peer, rss is not None and rss >= th.rss_critical_gb,
             CRITICAL, round=rec.get("round"), rss_gb=rss))
+        free = r.get("disk_free_gb")
+        if free is not None:
+            sev = (CRITICAL if free <= th.disk_low_critical_gb
+                   else WARN if free <= th.disk_low_warn_gb else None)
+            out.extend(alerts.set_state(
+                "disk_low", peer, sev is not None, sev or WARN,
+                round=rec.get("round"), disk_free_gb=free))
     return out
 
 
@@ -950,6 +995,8 @@ def monitor_main(argv=None) -> int:
     ap.add_argument("--staleness-p95-warn", type=float, default=None)
     ap.add_argument("--trust-warn", type=float, default=None)
     ap.add_argument("--rss-critical-gb", type=float, default=None)
+    ap.add_argument("--disk-low-warn-gb", type=float, default=None)
+    ap.add_argument("--disk-low-critical-gb", type=float, default=None)
     args = ap.parse_args(argv)
 
     names = None
@@ -965,7 +1012,9 @@ def monitor_main(argv=None) -> int:
                        ("stall_critical_s", "round_stall_critical_s"),
                        ("staleness_p95_warn", "staleness_p95_warn"),
                        ("trust_warn", "trust_warn"),
-                       ("rss_critical_gb", "rss_critical_gb")):
+                       ("rss_critical_gb", "rss_critical_gb"),
+                       ("disk_low_warn_gb", "disk_low_warn_gb"),
+                       ("disk_low_critical_gb", "disk_low_critical_gb")):
         v = getattr(args, arg)
         if v is not None:
             setattr(th, field, v)

@@ -632,4 +632,107 @@ print("storage leg: scrub flagged the damage, repair verified+adopted "
       "monitor + batch trace CLEAN (repair_authenticated, "
       "no_rollback_readmission armed)")
 EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+# Limp leg (ROBUSTNESS.md §11 "Gray-failure adversary model"): 2 peers,
+# peer 1 limping two ways at once — the seeded in-process lane (train-seam
+# stalls + direction-keyed link throttle, FaultPlan.limp_*) AND
+# supervisor-driven SIGSTOP/SIGCONT freeze cycles (the process never dies,
+# it just goes silent mid-round) — with the adaptive phi detector grading
+# the slowness and a live monitor attached. Gates: BOTH peers converge to
+# the horizon (a limping peer is slow, not dead — the run must absorb it),
+# the injected limp is observed in the stream (limp.inject), the pause
+# cycles actually fired, peer 1 is down-weighted but NEVER quarantined
+# (slowness_is_not_malice armed and clean, zero peer-scope quarantine
+# transitions), and monitor/batch verdicts agree. The long-horizon
+# composition (limp + wire + churn, leadered AND gossip, unlimped-twin
+# convergence gate) is scripts/dist_soak.py --limp.
+echo
+echo "limp leg: 2 peers, seeded stalls/throttle + SIGSTOP pauses on peer 1"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
+                             PartitionConfig)
+from bcfl_tpu.dist.harness import run_dist
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.reputation import ReputationConfig
+from bcfl_tpu.telemetry import collate, read_stream
+
+run_dir = "/tmp/bcfl_chaos_limp_run"
+if os.path.isdir(run_dir):
+    shutil.rmtree(run_dir)
+os.makedirs(run_dir)
+stop = os.path.join(run_dir, "monitor.stop")
+summary_path = "/tmp/bcfl_chaos_limp_summary.json"
+mon = subprocess.Popen(
+    [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor", run_dir,
+     "--quiet", "--poll", "0.5", "--stop-file", stop,
+     "--summary-out", summary_path, "--max-wall", "500", "--idle", "400",
+     "--stall-critical-s", "600"])
+cfg = FedConfig(
+    name="limp_smoke", runtime="dist", mode="server", sync="async",
+    model="tiny-bert", dataset="synthetic", num_clients=4, num_rounds=6,
+    seq_len=16, batch_size=4, max_local_batches=2, eval_every=0, seed=42,
+    partition=PartitionConfig(kind="iid", iid_samples=8),
+    ledger=LedgerConfig(enabled=True),
+    reputation=ReputationConfig(enabled=True),
+    faults=FaultPlan(seed=7, limp_prob=0.6, limp_peers=(1,),
+                     limp_stall_s=0.5, limp_throttle_bps=262144.0),
+    dist=DistConfig(peers=2, buffer_timeout_s=10.0, idle_timeout_s=90.0,
+                    peer_deadline_s=300.0, checkpoint_every_versions=1))
+try:
+    result = run_dist(cfg, run_dir, deadline_s=400.0, platform="cpu",
+                      limp={"peer": 1, "pause_s": 2.0, "period_s": 8.0,
+                            "cycles": 2, "stop_after_s": 120.0})
+finally:
+    with open(stop, "w") as f:
+        f.write("done\n")
+mon_rc = mon.wait(timeout=120)
+assert result["ok"], (result["returncodes"], result["log_tails"])
+assert result["limp"], "no SIGSTOP pause cycle ever fired"
+injects = quarantines = slow_rows = 0
+for path in result["event_streams"]:
+    evs, _ = read_stream(path)
+    for e in evs:
+        if e["ev"] == "limp.inject":
+            injects += 1
+        elif (e["ev"] == "rep.transition"
+              and e.get("to") == "quarantined"
+              and e.get("scope") == "peer"):
+            quarantines += 1
+        elif (e["ev"] == "rep.dist_evidence"
+              and e.get("source") == "slowness"):
+            slow_rows += 1
+assert injects > 0, "the seeded limp lane never fired (no limp.inject)"
+assert quarantines == 0, (
+    f"an honest-but-slow peer was quarantined ({quarantines} "
+    "peer-scope transitions) — slow must never read as malicious")
+for p in (0, 1):
+    rep = result["reports"].get(p) or {}
+    assert rep.get("status") == "ok", (p, rep.get("status"))
+    assert (rep.get("final_version") or 0) >= cfg.num_rounds, (
+        "a limping fleet must still converge", p, rep.get("final_version"))
+assert mon_rc == 0, f"live monitor exited {mon_rc} on the limp run"
+col = collate(result["event_streams"])
+col.pop("ordered")
+assert col["ok"], col["violations"]
+assert "slowness_is_not_malice" in col["invariants"], (
+    "slowness_is_not_malice missing from the batch suite")
+with open(summary_path) as f:
+    mon_summary = json.load(f)
+assert mon_summary["invariants"] == col["invariants"], (
+    "monitor-vs-trace verdict drift", mon_summary["invariants"],
+    col["invariants"])
+print("limp leg: both peers converged to "
+      f"{[result['reports'][p].get('final_version') for p in (0, 1)]} "
+      f"under {injects} limp injections + {len(result['limp'])} SIGSTOP "
+      f"cycles ({slow_rows} slowness evidence rows, 0 quarantines), "
+      "monitor + batch trace CLEAN (slowness_is_not_malice armed)")
+EOF
 exit $?

@@ -130,7 +130,8 @@ def build_cfg(args, wire: bool, chaos_seed: int, buffer: int = 0,
             # SUSPECT on the first failed attempt: makes the detector's
             # transition log rich under a 20% drop rate without changing
             # when the circuit opens (down_after)
-            suspect_after=1),
+            suspect_after=1,
+            detector=args.detector),
         checkpoint_dir=None,
     )
 
@@ -513,6 +514,12 @@ def main(argv=None) -> int:
                          "leg's wall as its telemetry-on measurement; "
                          "byzantine composes the wire lane with an "
                          "adversarial peer — needs >= 3 peers)")
+    ap.add_argument("--detector", choices=("phi", "fixed"),
+                    default="phi",
+                    help="failure-detector policy (RUNTIME.md \u00a73 "
+                         "'Timing contract'): fixed replays every leg on "
+                         "the pre-gray-failure consecutive-counter + "
+                         "static-deadline path, bit-compatibly")
     ap.add_argument("--buffer-timeout", type=float, default=10.0)
     ap.add_argument("--deadline", type=float, default=600.0)
     ap.add_argument("--idle-timeout", type=float, default=120.0)

@@ -29,6 +29,22 @@ long-horizon composition none of them exercises:
   the tampered transfer provably refused — on top of the zero-violation
   gates, which now include ``repair_authenticated`` and
   ``no_rollback_readmission``,
+- **limp (``--limp``)** — the gray-failure adversary (ROBUSTNESS.md §11):
+  one peer is SLOW instead of dead or malicious. The in-process seeded
+  lane (``FaultPlan.limp_*``) stalls its train step and throttles its
+  links on per-(peer, round) draws; the supervisor additionally
+  SIGSTOP/SIGCONT-freezes the same peer in cycles (the GC-pause /
+  CPU-starvation signature fixed-timeout detectors flap on). The
+  byzantine lane is DISARMED on this leg — the composition under test is
+  limp+wire+churn, and the acceptance question is precisely whether an
+  honest-but-slow peer is DOWN-WEIGHTED (phi-accrual suspicion feeding
+  the w_slow reputation lane) without ever being quarantined. Extra
+  gates: the limp lanes actually fired (seeded injections + supervisor
+  pauses), the phi estimator's suspicion series landed in the streams,
+  ZERO ``slowness_is_not_malice`` violations, the limping peer is never
+  quarantined anywhere in the fleet, and the mean final eval loss lands
+  within ``--converge-tol`` of an UNLIMPED TWIN (identical shape, seed,
+  wire+churn plan, same dispatch, limp lanes off),
 
 while ``bcfl-tpu monitor`` is attached CONCURRENTLY in ``--fail-fast``
 mode: a watcher thread reaps the whole fleet the moment the monitor exits
@@ -68,7 +84,7 @@ assumed).
 
 Usage: python scripts/dist_soak.py [--rounds 120] [--peers 3]
            [--deadline 2700] [--platform cpu] [--quick]
-           [--dispatch {leader,gossip}] [--storage]
+           [--dispatch {leader,gossip}] [--storage] [--limp]
 """
 
 from __future__ import annotations
@@ -98,7 +114,7 @@ def _mean_final_loss(reports):
     return (sum(losses) / len(losses)) if losses else None
 
 
-def build_cfg(args, dispatch=None, name="dist_soak"):
+def build_cfg(args, dispatch=None, name="dist_soak", limp=None):
     from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
                                  PartitionConfig)
     from bcfl_tpu.faults import FaultPlan
@@ -107,15 +123,30 @@ def build_cfg(args, dispatch=None, name="dist_soak"):
     dispatch = dispatch or args.dispatch
 
     storage = bool(getattr(args, "storage", False))
+    # leg_limp: the --limp LEG is selected (byzantine disarmed — the last
+    # peer is honest-but-slow, not malicious). The `limp` param controls
+    # whether the limp lanes are actually ARMED: the unlimped twin passes
+    # limp=False to get an identical config that differs ONLY in limping.
+    leg_limp = bool(getattr(args, "limp", False))
+    limp = leg_limp if limp is None else bool(limp)
     plan = FaultPlan(
         seed=args.chaos_seed,
         wire_drop_prob=args.wire_drop, wire_dup_prob=args.wire_dup,
         wire_reorder_prob=args.wire_reorder, wire_reorder_hold_s=0.2,
         wire_delay_prob=args.wire_delay, wire_delay_s=0.05,
         wire_corrupt_prob=args.wire_corrupt,
-        # the adversary lies for the WHOLE horizon, not a burst
-        byz_peers=(args.peers - 1,), byz_prob=1.0,
-        byz_behaviors=("scale", "digest_forge"),
+        # the adversary lies for the WHOLE horizon, not a burst — except
+        # on the limp leg, where the last peer is honest-but-slow instead
+        # of malicious (the gray-failure composition is limp+wire+churn)
+        **({} if leg_limp else
+           {"byz_peers": (args.peers - 1,), "byz_prob": 1.0,
+            "byz_behaviors": ("scale", "digest_forge")}),
+        # limp lane (in-process half): seeded per-(peer, round) train
+        # stalls + direction-keyed link throttling of the slow peer
+        **({"limp_peers": (args.peers - 1,),
+            "limp_prob": args.limp_prob,
+            "limp_stall_s": args.limp_stall,
+            "limp_throttle_bps": args.limp_throttle_bps} if limp else {}),
         # storage lane (in-process half): the churned follower damages
         # its OWN fresh checkpoints post-commit on seeded draws; the
         # leader's first STATE_SYNC serve to it is tampered in flight —
@@ -221,6 +252,26 @@ def main(argv=None) -> int:
     ap.add_argument("--storage-prob", type=float, default=0.3,
                     help="in-process seeded lane-8 damage probability "
                          "per committed checkpoint of the churned peer")
+    ap.add_argument("--limp", action="store_true",
+                    help="arm the gray-failure adversary: the last peer "
+                         "limps (seeded train stalls + link throttling + "
+                         "supervisor SIGSTOP pauses) instead of lying; "
+                         "gates on down-weight-never-quarantine and on "
+                         "convergence vs an unlimped twin "
+                         "(ROBUSTNESS.md §11)")
+    ap.add_argument("--limp-prob", type=float, default=0.35,
+                    help="per-(peer, round) seeded limp draw probability")
+    ap.add_argument("--limp-stall", type=float, default=2.0,
+                    help="train-seam stall seconds per limp draw")
+    ap.add_argument("--limp-throttle-bps", type=float, default=262144,
+                    help="throttled link bandwidth for limped rounds "
+                         "(bytes/s; 0 disables throttling)")
+    ap.add_argument("--limp-cycles", type=int, default=3,
+                    help="supervisor SIGSTOP/SIGCONT pause cycles")
+    ap.add_argument("--limp-period", type=float, default=30.0,
+                    help="seconds between supervisor pause cycles")
+    ap.add_argument("--limp-pause", type=float, default=3.0,
+                    help="seconds the peer stays frozen per cycle")
     ap.add_argument("--dispatch", choices=("leader", "gossip"),
                     default="leader",
                     help="dist execution mode; 'gossip' soaks the "
@@ -248,6 +299,8 @@ def main(argv=None) -> int:
         args.rounds = min(args.rounds, 12)
         args.churn_cycles = 1
         args.churn_period = 20.0
+        args.limp_cycles = 1
+        args.limp_period = 15.0
         args.deadline = min(args.deadline, 900.0)
     from bcfl_tpu.faults.plan import STORAGE_CLASSES
 
@@ -271,7 +324,8 @@ def main(argv=None) -> int:
     stop_path = os.path.join(run_dir, "monitor.stop")
     summary_path = os.path.join(run_dir, "monitor_summary.json")
 
-    adversary = args.peers - 1
+    adversary = args.peers - 1       # honest-but-slow on the limp leg
+    limp_peer = args.peers - 1
     churn_peer = 1  # a follower that is neither leader nor adversary
     # the last rejoin must land while the mesh is alive: close the churn
     # window well before the horizon plausibly completes
@@ -286,7 +340,13 @@ def main(argv=None) -> int:
              **({"damage": list(STORAGE_CLASSES), "bootstrap": True}
                 if args.storage else {})}
 
-    lanes = "wire+byzantine+churn" + ("+storage" if args.storage else "")
+    limp = ({"peer": limp_peer, "cycles": args.limp_cycles,
+             "period_s": args.limp_period, "pause_s": args.limp_pause,
+             "stop_after_s": args.deadline * 0.5}
+            if args.limp else None)
+
+    lanes = ("wire+limp+churn" if args.limp else "wire+byzantine+churn") \
+        + ("+storage" if args.storage else "")
     print(f"dist_soak[{args.dispatch}]: {args.peers} peers x "
           f"{args.clients // args.peers} clients, target {args.rounds} "
           f"versions; {lanes} armed, monitor attached live "
@@ -314,7 +374,8 @@ def main(argv=None) -> int:
     watcher.start()
     try:
         result = harness.run_dist(cfg, run_dir, deadline_s=args.deadline,
-                                  platform=args.platform, churn=churn)
+                                  platform=args.platform, churn=churn,
+                                  limp=limp)
     finally:
         run_done.set()
     # fleet done: tell the monitor to finalize (all_closed usually beats
@@ -373,6 +434,10 @@ def main(argv=None) -> int:
     storage_chaos_classes = set()    # in-process lane-8 injections
     sync_adopts = sync_refusals = tampered_serves = 0
     tamper_refused = 0               # refusals with the tamper's signature
+    limp_injects = 0                 # seeded in-process stall/throttle hits
+    phi_samples = 0                  # detector.phi suspicion series
+    slowness_evidence = 0            # rep.dist_evidence source=slowness
+    limp_peer_quarantines = 0        # rep.transition -> quarantined, target
     for path in result["event_streams"]:
         evs, _ = read_stream(path)
         for e in evs:
@@ -391,6 +456,17 @@ def main(argv=None) -> int:
                     tamper_refused += 1
             elif ev == "state.sync.serve" and e.get("tampered"):
                 tampered_serves += 1
+            elif ev == "limp.inject":
+                limp_injects += 1
+            elif ev == "detector.phi":
+                phi_samples += 1
+            elif (ev == "rep.dist_evidence"
+                    and e.get("source") == "slowness"):
+                slowness_evidence += 1
+            elif (ev == "rep.transition" and e.get("scope") == "peer"
+                    and e.get("to") == "quarantined"
+                    and e.get("client") == limp_peer):
+                limp_peer_quarantines += 1
 
     if args.dispatch == "gossip":
         # leaderless: there is no peer whose clock speaks for the fleet —
@@ -406,23 +482,29 @@ def main(argv=None) -> int:
     # gossip acceptance (ISSUE 16): the chaos-soaked gossip fleet must
     # converge within tolerance of its LEADERED TWIN — identical shape,
     # seed, and wire+byzantine+churn plan, dispatch="leader" — run
-    # sequentially as the reference (no monitor; gates only need its eval)
+    # sequentially as the reference (no monitor; gates only need its eval).
+    # The --limp leg replaces it with the UNLIMPED TWIN (ISSUE 18): same
+    # dispatch, same wire+churn plan, limp lanes off — the reference that
+    # isolates what the gray failure cost.
     twin = None
-    if args.dispatch == "gossip":
+    if args.dispatch == "gossip" or args.limp:
         twin_dir = run_dir + "_twin"
         if os.path.isdir(twin_dir):
             shutil.rmtree(twin_dir)
         os.makedirs(twin_dir, exist_ok=True)
-        print(f"dist_soak: launching leadered twin (convergence "
+        kind = "unlimped" if args.limp else "leadered"
+        print(f"dist_soak: launching {kind} twin (convergence "
               f"reference) -> {twin_dir}", flush=True)
-        twin_cfg = build_cfg(args, dispatch="leader",
-                             name="dist_soak_twin")
+        twin_cfg = (build_cfg(args, name="dist_soak_twin", limp=False)
+                    if args.limp else
+                    build_cfg(args, dispatch="leader",
+                              name="dist_soak_twin"))
         twin_result = harness.run_dist(
             twin_cfg, twin_dir, deadline_s=args.deadline,
             platform=args.platform, churn=dict(churn))
         twin_reports = twin_result["reports"]
         twin = {
-            "run_dir": twin_dir,
+            "run_dir": twin_dir, "kind": kind,
             "ok": twin_result["ok"],
             "final_versions": {p: r.get("final_version")
                                for p, r in twin_reports.items()},
@@ -447,15 +529,37 @@ def main(argv=None) -> int:
             bool(health_rounds) and health_rounds[-1] >= args.rounds),
         "churn_cycles_completed": (
             len(result.get("churn") or []) >= args.churn_cycles),
-        "byz_injections_nonzero": byz_total > 0,
-        "adversary_distrusted": (
-            adv_state == "quarantined"
-            or (adv_trust is not None and adv_trust < 0.7)),
         "resource_samples_recorded": resource_samples > 0,
         "chains_verify": bool(reports) and all(
             rep.get("chain_ok") in (True, None)
             for rep in reports.values()),
     }
+    if not args.limp:
+        # byzantine lane gates (disarmed on the limp leg by design)
+        gates["byz_injections_nonzero"] = byz_total > 0
+        gates["adversary_distrusted"] = (
+            adv_state == "quarantined"
+            or (adv_trust is not None and adv_trust < 0.7))
+    else:
+        # gray-failure acceptance (ISSUE 18): the lanes actually fired,
+        # the phi estimator's suspicion series landed, slowness evidence
+        # accrued, and the honest-slow peer was down-weighted — NEVER
+        # quarantined, by any peer, at any point of the horizon
+        gates["limp_pause_cycles_completed"] = (
+            len(result.get("limp") or []) >= args.limp_cycles)
+        gates["limp_injections_nonzero"] = limp_injects > 0
+        gates["phi_suspicion_observed"] = phi_samples > 0
+        gates["slowness_evidence_observed"] = slowness_evidence > 0
+        gates["honest_slow_never_quarantined"] = (
+            limp_peer_quarantines == 0)
+        gates["zero_slowness_is_not_malice_violations"] = (
+            batch_inv.get("slowness_is_not_malice", 0) == 0)
+        twin_loss = twin["loss"] if twin else None
+        limp_loss = _mean_final_loss(reports)
+        gates["limp_converged_vs_unlimped_twin"] = (
+            limp_loss is not None and twin_loss is not None
+            and abs(limp_loss - twin_loss)
+            <= args.converge_tol * max(abs(twin_loss), 1e-6))
     storage_damage_classes = set()
     if args.storage:
         # supervisor-side injections (one class per churn cycle) union
@@ -478,11 +582,14 @@ def main(argv=None) -> int:
         # kill/rejoin cycles show up as catalogued membership.leave /
         # membership.join transitions in the survivors' streams
         gates["membership_churn_observed"] = membership_events > 0
-        twin_loss = twin["loss"] if twin else None
-        gates["gossip_converged_vs_leadered_twin"] = (
-            gossip_loss is not None and twin_loss is not None
-            and abs(gossip_loss - twin_loss)
-            <= args.converge_tol * max(abs(twin_loss), 1e-6))
+        if not args.limp:
+            # the limp leg's twin is the unlimped SAME-dispatch fleet
+            # (gated above), not the leadered reference
+            twin_loss = twin["loss"] if twin else None
+            gates["gossip_converged_vs_leadered_twin"] = (
+                gossip_loss is not None and twin_loss is not None
+                and abs(gossip_loss - twin_loss)
+                <= args.converge_tol * max(abs(twin_loss), 1e-6))
     record = {
         "proof": "dist_soak", "peers": args.peers,
         "dispatch": args.dispatch,
@@ -493,12 +600,27 @@ def main(argv=None) -> int:
                      "reorder": args.wire_reorder,
                      "delay": args.wire_delay,
                      "corrupt": args.wire_corrupt},
-            "byzantine": {"peer": adversary, "injections": byz_total,
-                          "state_at_leader": adv_state,
-                          "trust_at_leader": adv_trust},
+            "byzantine": (None if args.limp else
+                          {"peer": adversary, "injections": byz_total,
+                           "state_at_leader": adv_state,
+                           "trust_at_leader": adv_trust}),
             "churn": {"peer": churn_peer,
                       "cycles": result.get("churn"),
                       "membership_events": membership_events},
+            "limp": ({
+                "armed": True, "peer": limp_peer,
+                "prob": args.limp_prob, "stall_s": args.limp_stall,
+                "throttle_bps": args.limp_throttle_bps,
+                "pause_cycles": result.get("limp"),
+                "injections": limp_injects,
+                "phi_samples": phi_samples,
+                "slowness_evidence": slowness_evidence,
+                "quarantine_transitions": limp_peer_quarantines,
+                "state_at_leader": (leader_rep.get("state")
+                                    or [None] * args.peers)[limp_peer],
+                "slow_at_leader": (leader_rep.get("slow")
+                                   or [None] * args.peers)[limp_peer],
+            } if args.limp else None),
             "storage": ({
                 "armed": True, "prob": args.storage_prob,
                 "classes_injected": sorted(storage_damage_classes),

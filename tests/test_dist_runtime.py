@@ -164,17 +164,22 @@ def test_capability_table_is_total_and_enforced():
     # every row resolves to supported (True) or a declared reason (str)
     for feature, active, verdict in rows:
         assert verdict is True or (isinstance(verdict, str) and verdict)
-    # the local runtime supports everything except the wire, byzantine and
-    # storage lanes — the features that only exist at a real socket
-    # boundary (frames to damage, wire headers/digest announcements to
-    # forge) or against real per-peer durable state (checkpoints to
-    # corrupt, neighbors to repair from)
+    # the local runtime supports everything except the wire, byzantine,
+    # storage, limp and resource lanes — the features that only exist at
+    # a real socket boundary (frames to damage, links to throttle, wire
+    # headers/digest announcements to forge) or against real per-peer
+    # durable state (checkpoints to corrupt, writes to fail, neighbors
+    # to repair from)
     for feature, _, verdict in capability_table(FedConfig()):
         if feature.startswith("chaos: wire"):
             assert isinstance(verdict, str) and "socket" in verdict
         elif feature.startswith("chaos: byzantine"):
             assert isinstance(verdict, str) and "wire" in verdict
         elif feature.startswith("chaos: storage"):
+            assert isinstance(verdict, str) and "durable" in verdict
+        elif feature.startswith("chaos: limp"):
+            assert isinstance(verdict, str) and "detector" in verdict
+        elif feature.startswith("chaos: resource"):
             assert isinstance(verdict, str) and "durable" in verdict
         else:
             assert verdict is True

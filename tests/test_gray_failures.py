@@ -1,0 +1,793 @@
+"""Gray-failure adversary layer (ROBUSTNESS.md §11, RUNTIME.md "Timing
+contract").
+
+What this suite pins, layer by layer:
+
+- **FaultPlan limp + resource lanes** — seeded per-coordinate draws:
+  identical coordinates always replay the identical limp/throttle/
+  write-failure, disarmed peers and out-of-span clocks draw None, the
+  throttle is DIRECTION-keyed ((src, dst) and (dst, src) independent,
+  ``limp_oneway`` restricts to the limp peer's outbound side), a
+  disabled lane is a bit-identical passthrough (every draw None), and
+  every armed-but-vacuous plan shape is rejected at construction
+  (config-level gates included: dist-only caps, peer-id bounds).
+- **Phi-accrual estimator** (``detector="phi"``) — suspicion is monotone
+  in silence, any liveness evidence snaps it back to zero, consecutive
+  failures grade EXACTLY like the fixed counter's thresholds (the
+  compatibility contract), states stay the shared lowercase vocabulary,
+  and the adaptive send budget scales with frame size — the large-frame
+  starvation fix, including the 32 MB-frame-on-a-throttled-link
+  regression end to end over a real loopback transport.
+- **detector="fixed" pin** — the transport instantiates the plain
+  counter, which exposes NO adaptive surface (``send_budget_s`` /
+  ``note_rtt`` absent, no phi block in stats()), so the pre-gray-failure
+  send path (static ``send_deadline_s``) is preserved verbatim.
+- **Resource-lane response ladder** — ENOSPC/EMFILE at a durable seam
+  walks emergency retention GC -> telemetry shed -> DurabilityError
+  (distinct exit code) with depth-1/2/3 semantics, real (non-injected)
+  errno 28/24 walks the same ladder, foreign errors pass through, the
+  events seam auto-sheds inside the writer and NEVER escalates.
+- **w_slow degradation** — slowness evidence down-weights the gate but
+  structurally cannot quarantine; the malice lanes still can; the
+  ``slowness_is_not_malice`` invariant's batch and streaming twins agree
+  needle-by-needle on the fixture matrix.
+- **3-peer loopback limping run** — a seeded limp peer completes the
+  federation down-weighted but never quarantined, with limp injections
+  and phi samples in the stream and the full invariant suite clean.
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from bcfl_tpu.config import DistConfig
+from bcfl_tpu.dist.harness import free_ports
+from bcfl_tpu.dist.transport import (
+    DOWN,
+    REACHABLE,
+    SUSPECT,
+    FailureDetector,
+    LimpChaos,
+    PeerTransport,
+    PhiFailureDetector,
+)
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.faults.plan import RESOURCE_CLASSES, RESOURCE_SEAMS
+from bcfl_tpu.telemetry.invariants import slowness_is_not_malice
+from bcfl_tpu.telemetry.live import SSlownessIsNotMalice
+
+pytestmark = [pytest.mark.dist, pytest.mark.faults]
+
+
+# --------------------------------------------------------- seeded limp lane
+
+
+def test_limp_draws_deterministic_and_bounded():
+    def mk():
+        return FaultPlan(seed=9, limp_peers=(1, 2), limp_prob=0.5,
+                         limp_stall_s=0.7, limp_throttle_bps=65536.0,
+                         limp_rounds=tuple(range(1, 30)))
+
+    a, b = mk(), mk()
+    assert a.limp_enabled and a.enabled
+    grid = [(r, p) for r in range(30) for p in range(3)]
+    draws = [a.limp_action(r, p) for r, p in grid]
+    assert draws == [b.limp_action(r, p) for r, p in grid]
+    # disarmed peer and out-of-span round draw None, always
+    assert all(d is None for (r, p), d in zip(grid, draws) if p == 0)
+    assert all(d is None for (r, p), d in zip(grid, draws) if r == 0)
+    fired = [d for d in draws if d]
+    assert fired, "armed limp lane never fired across 30x3 draws"
+    for d in fired:
+        assert d == {"stall_s": 0.7, "throttle_bps": 65536.0}
+
+
+def test_limp_throttle_direction_keyed():
+    plan = FaultPlan(seed=9, limp_peers=(1,), limp_prob=0.5,
+                     limp_stall_s=0.0, limp_throttle_bps=65536.0)
+    again = FaultPlan(seed=9, limp_peers=(1,), limp_prob=0.5,
+                      limp_stall_s=0.0, limp_throttle_bps=65536.0)
+    grid = [(r, s, d) for r in range(40) for s in range(3)
+            for d in range(3) if s != d]
+    draws = {k: plan.limp_throttle(*k) for k in grid}
+    assert draws == {k: again.limp_throttle(*k) for k in grid}
+    # only directions TOUCHING the limp peer are ever eligible...
+    assert all(v is None for (r, s, d), v in draws.items()
+               if s != 1 and d != 1)
+    # ...and the ordered pair draws independently: some round where
+    # exactly one of (1->0, 0->1) limps proves direction keying
+    asym = [r for r in range(40)
+            if (draws[(r, 1, 0)] is None) != (draws[(r, 0, 1)] is None)]
+    assert asym, "throttle draws never diverged across directions"
+    assert {v for v in draws.values() if v is not None} == {65536.0}
+    # limp_oneway: ONLY the limp peer's outbound side is eligible
+    one = FaultPlan(seed=9, limp_peers=(1,), limp_prob=1.0,
+                    limp_throttle_bps=65536.0, limp_oneway=True)
+    assert all(one.limp_throttle(r, 0, 1) is None for r in range(20))
+    assert any(one.limp_throttle(r, 1, 0) for r in range(20))
+
+
+def test_disabled_lanes_are_bit_identical_passthrough():
+    plan = FaultPlan()  # nothing armed
+    assert not plan.limp_enabled and not plan.resource_enabled
+    for r in range(25):
+        for p in range(4):
+            assert plan.limp_action(r, p) is None
+            for d in range(4):
+                if p != d:
+                    assert plan.limp_throttle(r, p, d) is None
+    for seam in RESOURCE_SEAMS:
+        assert all(plan.resource_action(seam, c, p) is None
+                   for c in range(25) for p in range(4))
+
+
+# ----------------------------------------------------- seeded resource lane
+
+
+def test_resource_draws_deterministic_and_bounded():
+    def mk():
+        return FaultPlan(seed=13, resource_peers=(0, 2),
+                         resource_prob=0.5,
+                         resource_rounds=tuple(range(1, 30)))
+
+    a, b = mk(), mk()
+    assert a.resource_enabled
+    grid = [(s, c, p) for s in RESOURCE_SEAMS for c in range(30)
+            for p in range(3)]
+    draws = [a.resource_action(*k) for k in grid]
+    assert draws == [b.resource_action(*k) for k in grid]
+    assert all(d is None for (s, c, p), d in zip(grid, draws) if p == 1)
+    assert all(d is None for (s, c, p), d in zip(grid, draws) if c == 0)
+    fired = [d for d in draws if d]
+    assert fired, "armed resource lane never fired"
+    assert {d["cls"] for d in fired} <= set(RESOURCE_CLASSES)
+    assert {d["depth"] for d in fired} <= {1, 2, 3}
+    # seams draw independently (same counter, different seam, different
+    # fate somewhere across the span)
+    per_seam = {s: [a.resource_action(s, c, 0) is not None
+                    for c in range(30)] for s in RESOURCE_SEAMS}
+    assert len({tuple(v) for v in per_seam.values()}) > 1
+    # an unknown seam is a caller bug and fails loud
+    with pytest.raises(ValueError):
+        a.resource_action("bogus_seam", 1, 0)
+    # class subset bounds the draw
+    sub = FaultPlan(seed=13, resource_prob=1.0,
+                    resource_classes=("emfile",))
+    assert {sub.resource_action("ledger", c, 0)["cls"]
+            for c in range(10)} == {"emfile"}
+
+
+def test_vacuous_gray_plans_rejected():
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_peers=(0,))         # prob 0: never limps
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_prob=0.5, limp_stall_s=0.0,
+                  limp_throttle_bps=0.0)           # armed but does nothing
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_prob=0.5, limp_rounds=())
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_rounds=(2,))        # span without prob
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_prob=0.5, limp_stall_s=-1.0)
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_prob=1.5)
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, limp_peers=(0, 0), limp_prob=0.5)
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, resource_peers=(0,))     # prob 0: never fails
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, resource_prob=0.5, resource_classes=())
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, resource_prob=0.5, resource_classes=("bogus",))
+    with pytest.raises(ValueError):
+        FaultPlan(seed=1, resource_prob=1.5)
+
+
+def test_config_gray_lane_gates():
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+
+    base = dict(dataset="synthetic", model="tiny-bert", num_clients=4,
+                num_rounds=2, seq_len=16, batch_size=4, max_local_batches=2,
+                partition=PartitionConfig(kind="iid", iid_samples=8))
+    dist_base = dict(runtime="dist", mode="server", sync="async",
+                     eval_every=0)
+    limp = FaultPlan(seed=1, limp_peers=(0,), limp_prob=0.5)
+    resrc = FaultPlan(seed=1, resource_peers=(0,), resource_prob=0.5)
+    # both lanes are dist-only (RUNTIME_CAPS): local runtime rejected
+    with pytest.raises(ValueError, match="limp"):
+        FedConfig(**base, faults=limp)
+    with pytest.raises(ValueError, match="resource"):
+        FedConfig(**base, faults=resrc)
+    # peer ids must exist in the fleet
+    with pytest.raises(ValueError, match="limp_peers"):
+        FedConfig(**base, **dist_base, dist=DistConfig(peers=2),
+                  faults=FaultPlan(seed=1, limp_peers=(5,), limp_prob=0.5))
+    with pytest.raises(ValueError, match="resource_peers"):
+        FedConfig(**base, **dist_base, dist=DistConfig(peers=2),
+                  faults=FaultPlan(seed=1, resource_peers=(5,),
+                                   resource_prob=0.5))
+    ok = FedConfig(**base, **dist_base, faults=limp,
+                   dist=DistConfig(peers=2))
+    assert ok.faults.limp_enabled
+    # detector knobs are validated at DistConfig construction
+    with pytest.raises(ValueError, match="detector"):
+        DistConfig(detector="bogus")
+    with pytest.raises(ValueError):
+        DistConfig(phi_suspect=4.0, phi_down=2.0)
+    with pytest.raises(ValueError):
+        DistConfig(phi_window_floor_s=10.0, phi_window_ceil_s=5.0)
+    with pytest.raises(ValueError):
+        DistConfig(deadline_floor_s=10.0, deadline_ceil_s=5.0)
+
+
+# ------------------------------------------------------ phi estimator math
+
+
+def test_phi_monotone_in_silence_and_snaps_back():
+    # window pinned to exactly 1 s (floor == ceil) so silence maps to phi
+    # arithmetically: phi = max(0, silence/1 - 1)
+    det = PhiFailureDetector(2, phi_suspect=2.0, phi_down=6.0,
+                             window_floor_s=1.0, window_ceil_s=1.0)
+    assert det.state_of(1) == REACHABLE == "reachable"
+    det._last[1] = time.monotonic() - 2.0
+    p1 = det.phi(1)
+    det._last[1] = time.monotonic() - 3.2
+    p2 = det.phi(1)
+    assert 0.5 < p1 < p2, "phi is not monotone in silence"
+    assert det.state_of(1) == SUSPECT == "suspect"
+    det._last[1] = time.monotonic() - 8.0
+    assert det.phi(1) >= 6.0
+    assert det.state_of(1) == DOWN == "down"
+    # any inbound liveness evidence snaps phi back to ~0 and closes the
+    # circuit — the just-recovered limper is immediately usable again
+    det.on_inbound(1)
+    assert det.phi(1) < 0.5
+    assert det.state_of(1) == REACHABLE
+    # hostile/unknown sender ids never grow the peer table
+    det.on_inbound(99)
+    assert set(det.states()) == {0, 1}
+
+
+def test_phi_failure_grading_matches_fixed_counter():
+    # under pure consecutive send failures the phi defaults grade
+    # IDENTICALLY to the fixed counter (suspect_after=2 / down_after=6):
+    # the compatibility half of the detector="phi" contract
+    phi = PhiFailureDetector(2)   # defaults: phi_suspect=2, phi_down=6
+    fixed = FailureDetector(2)    # defaults: suspect_after=2, down_after=6
+    seen = []
+    for _ in range(6):
+        phi.on_failure(1)
+        fixed.on_failure(1)
+        assert phi.state_of(1) == fixed.state_of(1)
+        seen.append(phi.state_of(1))
+    assert seen[0] == REACHABLE and SUSPECT in seen and seen[-1] == DOWN
+    phi.on_success(1)
+    fixed.on_success(1)
+    assert phi.state_of(1) == fixed.state_of(1) == REACHABLE
+    hops = [(t["from"], t["to"]) for t in phi.transitions]
+    assert hops == [(t["from"], t["to"]) for t in fixed.transitions]
+
+
+def test_phi_window_learns_inbound_cadence():
+    det = PhiFailureDetector(2, window_floor_s=0.1, window_ceil_s=120.0)
+    snap = det.phi_snapshot()
+    assert set(snap) == {"0", "1"}
+    assert snap["1"]["window_s"] == 120.0   # ceiling is the prior
+    assert snap["1"]["rtt_s"] is None and snap["1"]["bps"] is None
+    for _ in range(12):  # a ~0.5 s inbound cadence, simulated
+        det._last[1] = time.monotonic() - 0.5
+        det.on_inbound(1)
+    got = det.phi_snapshot()["1"]
+    assert 0.1 <= got["window_s"] < 5.0, got   # learned, not the prior
+    assert got["phi"] < 0.5
+
+
+def test_adaptive_send_budget_scales_with_frame_size():
+    det = PhiFailureDetector(
+        2, deadline_floor_s=2.0, deadline_ceil_s=120.0,
+        min_bandwidth_bps=1_048_576.0, base_deadline_s=20.0)
+    # pre-sample: static base + size/min-bandwidth, floor/ceil clamped
+    assert det.send_budget_s(1, 0) == 20.0
+    assert det.send_budget_s(1, 32 << 20) == pytest.approx(52.0)
+    assert det.send_budget_s(1, 1 << 30) == 120.0   # ceiling
+    # small frames feed RTT only; large frames also feed throughput
+    det.note_rtt(1, 0.01, nbytes=100)
+    assert det.phi_snapshot()["1"]["bps"] is None
+    for _ in range(20):
+        det.note_rtt(1, 1.0, nbytes=1 << 20)    # a measured 1 MiB/s link
+    got = det.phi_snapshot()["1"]
+    assert got["bps"] == pytest.approx(1 << 20, rel=0.2)
+    # the 32 MiB budget now reflects the MEASURED link (halved for
+    # safety): well above the frame's genuine ~32 s wire time
+    budget = det.send_budget_s(1, 32 << 20)
+    assert budget >= 32.0 and budget <= 120.0
+    # fast link + tiny frame clamps at the floor, never sub-floor
+    fast = PhiFailureDetector(2, deadline_floor_s=2.0)
+    for _ in range(20):
+        fast.note_rtt(1, 0.001, nbytes=1 << 20)
+    assert fast.send_budget_s(1, 64) == 2.0
+
+
+def test_fixed_detector_exposes_no_adaptive_surface():
+    # detector="fixed" preserves the pre-gray-failure send path verbatim:
+    # the plain counter has no adaptive hooks, so _send_reliable's
+    # getattr probes fall back to the static policy deadline
+    fixed = FailureDetector(2)
+    assert getattr(fixed, "send_budget_s", None) is None
+    assert getattr(fixed, "note_rtt", None) is None
+    assert getattr(fixed, "phi_snapshot", None) is None
+    ports = free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    t_fixed = PeerTransport(0, addrs,
+                            policy=DistConfig(peers=2, detector="fixed"))
+    t_phi = PeerTransport(1, addrs,
+                          policy=DistConfig(peers=2, detector="phi"))
+    assert type(t_fixed.detector) is FailureDetector
+    assert isinstance(t_phi.detector, PhiFailureDetector)
+    assert "phi" not in t_fixed.stats()["detector"]
+    assert "phi" in t_phi.stats()["detector"]
+
+
+def test_32mb_frame_on_throttled_link_completes_without_flapping():
+    """The large-frame starvation regression (RUNTIME.md "Timing
+    contract"): a 32 MiB frame paced to 8 MiB/s by the limp lane needs
+    ~4 s of wire time — beyond the 3 s static deadline that used to
+    starve it into SUSPECT/DOWN flapping — and must complete in ONE
+    attempt under the adaptive size-proportional budget."""
+    plan = FaultPlan(seed=3, limp_peers=(0,), limp_prob=1.0,
+                     limp_stall_s=0.0, limp_throttle_bps=float(8 << 20),
+                     limp_oneway=True)
+    pol = DistConfig(peers=2, detector="phi", send_deadline_s=3.0,
+                     send_retries=2, deadline_floor_s=2.0,
+                     deadline_ceil_s=120.0)
+    ports = free_ports(2)
+    addrs = [("127.0.0.1", p) for p in ports]
+    a = PeerTransport(0, addrs, policy=pol,
+                      limp=LimpChaos(plan, clock_fn=lambda: 1))
+    b = PeerTransport(1, addrs, policy=pol)
+    b.start()
+    try:
+        trees = {"w": np.zeros(8 << 20, np.float32)}   # 32 MiB payload
+        t0 = time.monotonic()
+        assert a.send(1, {"type": "update"}, trees) is True
+        wall = time.monotonic() - t0
+        assert wall >= 3.0, f"throttle never paced the frame ({wall:.2f}s)"
+        assert a.limp_paced == 1
+        assert a.retries == 0 and a.send_failures == 0
+        assert a.detector.state_of(1) == REACHABLE
+        assert len(a.detector.transitions) == 0, \
+            list(a.detector.transitions)
+        got = b.recv(5.0)
+        assert got is not None and got[0]["type"] == "update"
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------- resource response ladder
+
+
+class _LadderHost:
+    """The minimal PeerRuntime shell `_durable_write` reads, with the two
+    remedy rungs replaced by recorders."""
+
+    def __init__(self, plan):
+        self.cfg = SimpleNamespace(faults=plan)
+        self.peer_id = 0
+        self.rungs = []
+
+    def _emergency_gc(self, seam):
+        self.rungs.append(("gc", seam))
+
+    def _shed_telemetry(self, seam):
+        self.rungs.append(("shed", seam))
+
+
+def _counters_by_depth(plan, seam="checkpoint"):
+    out = {}
+    for c in range(200):
+        act = plan.resource_action(seam, c, 0)
+        if act is not None:
+            out.setdefault(act["depth"], c)
+        if set(out) == {1, 2, 3}:
+            return out
+    raise AssertionError(f"depths seen: {sorted(out)}")
+
+
+def test_durable_write_ladder_depth_semantics(tmp_path):
+    from bcfl_tpu import telemetry as T
+    from bcfl_tpu.dist.runtime import DurabilityError, PeerRuntime
+    from bcfl_tpu.telemetry import read_stream
+
+    plan = FaultPlan(seed=21, resource_prob=1.0, resource_peers=(0,))
+    by_depth = _counters_by_depth(plan)
+    stream = str(tmp_path / "events_peer0.jsonl")
+    T.install(T.EventWriter(stream, peer=0, run="ladder"))
+    try:
+        # depth 1: one injected failure, cleared by emergency GC alone
+        host = _LadderHost(plan)
+        ran = []
+        got = PeerRuntime._durable_write(host, "checkpoint", by_depth[1],
+                                         lambda: ran.append(1) or "ok")
+        assert got == "ok" and ran == [1]
+        assert host.rungs == [("gc", "checkpoint")]
+        # depth 2: GC was not enough, the shed rung clears it
+        host = _LadderHost(plan)
+        got = PeerRuntime._durable_write(host, "checkpoint", by_depth[2],
+                                         lambda: "ok")
+        assert got == "ok"
+        assert host.rungs == [("gc", "checkpoint"), ("shed", "checkpoint")]
+        # depth 3: survives every remedy -> DurabilityError, write never
+        # ran (un-durable state is never silently committed). The draw is
+        # seam-keyed, so the ladder counter comes from the ledger seam.
+        led_depth = _counters_by_depth(plan, seam="ledger")
+        host = _LadderHost(plan)
+        ran = []
+        with pytest.raises(DurabilityError):
+            PeerRuntime._durable_write(host, "ledger", led_depth[3],
+                                       lambda: ran.append(1))
+        assert ran == [] and host.rungs == [("gc", "ledger"),
+                                            ("shed", "ledger")]
+    finally:
+        T.uninstall()
+    events, _meta = read_stream(stream)
+    inj = [e for e in events if e["ev"] == "resource.inject"]
+    assert len(inj) == 1 + 2 + 3   # depth injections, attempt-by-attempt
+    assert {e["cls"] for e in inj} <= set(RESOURCE_CLASSES)
+    assert all(e["errno"] in (28, 24) for e in inj)
+    assert {e["seam"] for e in inj} == {"checkpoint", "ledger"}
+
+
+def test_durable_write_real_errno_walks_ladder_and_foreign_raises():
+    from bcfl_tpu.dist.runtime import DurabilityError, PeerRuntime
+
+    # a REAL (non-injected) ENOSPC out of fn walks the same ladder
+    host = _LadderHost(FaultPlan())   # lane disabled: no injected draws
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError(28, "No space left on device")
+        return "landed"
+
+    assert PeerRuntime._durable_write(host, "checkpoint", 0, flaky) \
+        == "landed"
+    assert host.rungs == [("gc", "checkpoint"), ("shed", "checkpoint")]
+    # ...and one that never clears exits through DurabilityError
+    host = _LadderHost(FaultPlan())
+    with pytest.raises(DurabilityError):
+        PeerRuntime._durable_write(
+            host, "ledger", 0,
+            lambda: (_ for _ in ()).throw(OSError(24, "EMFILE")))
+    # foreign errnos are NOT the resource lane's: straight through
+    host = _LadderHost(FaultPlan())
+    with pytest.raises(OSError) as ei:
+        PeerRuntime._durable_write(
+            host, "checkpoint", 0,
+            lambda: (_ for _ in ()).throw(OSError(13, "EACCES")))
+    assert ei.value.errno == 13 and host.rungs == []
+
+
+def test_durability_exit_code_distinct():
+    from bcfl_tpu.dist.runtime import DurabilityError, ResumeError
+
+    assert DurabilityError.EXIT_CODE == 9
+    assert DurabilityError.EXIT_CODE != ResumeError.EXIT_CODE
+
+
+def test_emergency_gc_keeps_only_newest_round(tmp_path):
+    from bcfl_tpu import telemetry as T
+    from bcfl_tpu.checkpoint import restore_latest, save_checkpoint, scrub
+    from bcfl_tpu.dist.runtime import PeerRuntime
+    from bcfl_tpu.telemetry import read_stream
+
+    d = str(tmp_path / "ck")
+    for r in range(3):
+        save_checkpoint(d, r, {"w": np.full((4,), float(r), np.float32)})
+    host = SimpleNamespace(ckpt_dir=d)
+    stream = str(tmp_path / "events_peer0.jsonl")
+    T.install(T.EventWriter(stream, peer=0, run="gc"))
+    try:
+        PeerRuntime._emergency_gc(host, "checkpoint")
+    finally:
+        T.uninstall()
+    rep = scrub(d)
+    assert [r for r, _s in rep["rounds"]] == [2]
+    assert not rep["damaged"] and rep["newest_intact"] == 2
+    got = restore_latest(d)
+    assert got is not None and got[0] == 2
+    events, _ = read_stream(stream)
+    gcs = [e for e in events if e["ev"] == "gc.emergency"]
+    assert len(gcs) == 1
+    assert gcs[0]["removed"] == 2 and gcs[0]["kept"] == 1
+
+
+def test_shed_telemetry_idempotent_and_events_seam_auto_sheds(tmp_path):
+    from bcfl_tpu import telemetry as T
+    from bcfl_tpu.dist.runtime import PeerRuntime
+    from bcfl_tpu.telemetry import read_stream
+
+    stream = str(tmp_path / "events_peer0.jsonl")
+    w = T.EventWriter(stream, peer=0, run="shed", flush_every=1)
+    T.install(w)
+    try:
+        host = SimpleNamespace()
+        PeerRuntime._shed_telemetry(host, "ledger")
+        assert w.shedding
+        # sampled (high-rate) events are now counted, never buffered;
+        # never-sampled events keep flowing — the invariants read those
+        before = w.shed
+        T.emit_sampled("send.attempt", ("k",), to=1, msg_id=1, attempt=1,
+                       outcome="x")
+        assert w.shed == before + 1
+        T.emit("detector", target=1, **{"from": "reachable",
+                                        "to": "suspect"})
+        # the second shed call is a no-op (no duplicate write.shed)
+        PeerRuntime._shed_telemetry(host, "ledger")
+    finally:
+        T.uninstall()
+    events, _ = read_stream(stream)
+    sheds = [e for e in events if e["ev"] == "write.shed"]
+    assert len(sheds) == 1
+    assert sheds[0]["seam"] == "ledger" and sheds[0]["mode"] == "on"
+    assert not any(e["ev"] == "send.attempt" for e in events)
+    assert any(e["ev"] == "detector" for e in events)
+
+
+def test_event_writer_flush_fault_sheds_never_raises(tmp_path):
+    from bcfl_tpu import telemetry as T
+    from bcfl_tpu.telemetry import read_stream
+
+    stream = str(tmp_path / "events_peer0.jsonl")
+    w = T.EventWriter(stream, peer=0, run="enospc", flush_every=1)
+    fired = {"n": 0}
+
+    def fault(nbytes):
+        if fired["n"] == 0:
+            fired["n"] = 1
+            raise OSError(28, "No space left on device")
+
+    w.write_fault = fault
+    T.install(w)
+    try:
+        # the flush fails cleanly INSIDE the writer: the event is counted
+        # dropped, shedding turns on, write.shed lands in the next flush,
+        # and nothing ever propagates to the emitting thread
+        T.emit("detector", target=1, **{"from": "reachable",
+                                        "to": "suspect"})
+        assert w.shedding and w.dropped == 1
+        T.emit("detector", target=2, **{"from": "suspect",
+                                        "to": "down"})
+    finally:
+        T.uninstall()
+    events, meta = read_stream(stream)
+    sheds = [e for e in events if e["ev"] == "write.shed"]
+    assert len(sheds) == 1
+    assert sheds[0]["seam"] == "events" and sheds[0]["errno"] == 28
+    # the faulted line is gone (dropped), the post-shed one landed
+    targets = [e["target"] for e in events if e["ev"] == "detector"]
+    assert targets == [2]
+
+
+def test_events_write_fault_seam_draws_and_raises():
+    from bcfl_tpu.dist.runtime import PeerRuntime
+
+    plan = FaultPlan(seed=21, resource_prob=1.0, resource_peers=(0,))
+    host = SimpleNamespace(cfg=SimpleNamespace(faults=plan), peer_id=0,
+                           _events_fault_busy=False, _events_flush_n=0)
+    with pytest.raises(OSError) as ei:
+        PeerRuntime._events_write_fault(host, 1024)
+    assert ei.value.errno in (28, 24)
+    assert host._events_flush_n == 1 and not host._events_fault_busy
+    # the busy flag keeps the inject event's own flush from recursing
+    host._events_fault_busy = True
+    PeerRuntime._events_write_fault(host, 1024)   # no raise, no draw
+    assert host._events_flush_n == 1
+
+
+# ------------------------------------------------ w_slow: slow, not banned
+
+
+def test_note_slowness_downweights_but_cannot_quarantine():
+    from bcfl_tpu.reputation import ReputationConfig
+    from bcfl_tpu.reputation.dist import DistReputationTracker
+
+    cfg = ReputationConfig(enabled=True, w_slow=0.5)
+    rep = DistReputationTracker(cfg, peers=3, self_id=0)
+    g0 = rep.gate(1)
+    assert g0 > 0.0
+    state0 = rep.tracker.state.copy()
+    # saturate the slowness lane across many merges: the gate dims but
+    # the lifecycle state machine NEVER moves — slowness evidence
+    # structurally bypasses the _pending path
+    for _ in range(40):
+        rep.note_slowness(1, 1.0)
+        rep.observe_merge([1])
+    assert not rep.is_quarantined(1)
+    np.testing.assert_array_equal(rep.tracker.state, state0)
+    g_slow = rep.gate(1)
+    assert 0.0 < g_slow < g0
+    assert g_slow >= (1.0 - cfg.w_slow) * g0 * 0.99   # never silenced
+    # recovery is the same clock in reverse: zero observations decay it
+    for _ in range(60):
+        rep.note_slowness(1, 0.0)
+    assert rep.gate(1) > 0.9 * g0
+    # the MALICE lanes still quarantine — the asymmetry under test
+    for i in range(60):
+        rep.note_auth_failure(2, 1.0)
+        rep.observe_merge([2])
+        if rep.is_quarantined(2):
+            break
+    assert rep.is_quarantined(2), "auth-failure evidence never quarantined"
+    assert not rep.is_quarantined(1)
+    assert rep.gate(2) == 0.0 and rep.gate(1) > 0.0
+
+
+def test_slowness_evidence_emission_and_checkpoint_roundtrip(tmp_path):
+    from bcfl_tpu import telemetry as T
+    from bcfl_tpu.reputation import ReputationConfig
+    from bcfl_tpu.reputation.dist import DistReputationTracker
+    from bcfl_tpu.telemetry import read_stream
+
+    cfg = ReputationConfig(enabled=True)
+    rep = DistReputationTracker(cfg, peers=3, self_id=0)
+    stream = str(tmp_path / "events_peer0.jsonl")
+    T.install(T.EventWriter(stream, peer=0, run="slow"))
+    try:
+        rep.note_slowness(1, 0.8)
+        rep.note_slowness(2, 0.0)   # healthy: folded, NOT emitted
+        rep.note_slowness(7, 1.0)   # out of range: ignored
+    finally:
+        T.uninstall()
+    events, _ = read_stream(stream)
+    rows = [e for e in events if e["ev"] == "rep.dist_evidence"]
+    assert len(rows) == 1
+    assert rows[0]["source"] == "slowness" and rows[0]["target"] == 1
+    assert rows[0]["fault"] == 0.8
+    # the EWMA rides the checkpoint bit-for-bit under the rep_slow key
+    snap = rep.checkpoint_state()
+    assert "rep_slow" in snap
+    fresh = DistReputationTracker(cfg, peers=3, self_id=0)
+    fresh.restore(snap)
+    np.testing.assert_array_equal(fresh._slow, rep._slow)
+    # ...and the report carries both readable and exact forms
+    report = rep.report()
+    assert len(report["slow"]) == 3 == len(report["slow_hex"])
+    assert report["slow"][1] > 0.0
+    assert float.fromhex(report["slow_hex"][1]) == rep._slow[1]
+
+
+def test_w_slow_validated():
+    from bcfl_tpu.reputation import ReputationConfig
+
+    with pytest.raises(ValueError, match="w_slow"):
+        ReputationConfig(w_slow=1.0)    # 1.0 could silence a vote
+    with pytest.raises(ValueError, match="w_slow"):
+        ReputationConfig(w_slow=-0.1)
+    assert ReputationConfig(w_slow=0.0).w_slow == 0.0
+
+
+def test_gossip_hedge_deterministic_and_bounded():
+    from bcfl_tpu.dist.gossip import hedge_neighbors
+
+    live = (0, 1, 2, 3, 4, 5)
+    susp = {2: 3.5, 4: 0.1}
+    a = hedge_neighbors(7, 3, 0, live, (1, 2, 4), susp, 2.0)
+    assert a == hedge_neighbors(7, 3, 0, live, (1, 2, 4), susp, 2.0)
+    new, dropped = a
+    assert dropped == (2,)
+    assert 2 not in new and 1 in new and 4 in new
+    assert len(new) == 3 and 0 not in new   # replacement drawn, not self
+    # nothing suspicious: untouched passthrough
+    assert hedge_neighbors(7, 3, 0, live, (1, 4), susp, 2.0) \
+        == ((1, 4), ())
+    # empty replacement pool: the fanout shrinks instead of insisting
+    all_susp = {p: 9.0 for p in live}
+    new2, dropped2 = hedge_neighbors(7, 3, 0, live, (1, 2), all_susp, 2.0)
+    assert new2 == () and dropped2 == (1, 2)
+
+
+# ------------------------------------ slowness_is_not_malice needle matrix
+
+
+def _ev(ev, seq, **fields):
+    return {"v": 1, "ev": ev, "run": "fx", "peer": 0, "pid": 10,
+            "seq": seq, "t_wall": float(seq), "t_mono": float(seq),
+            **fields}
+
+
+def _slow_ev(seq, target=2, source="slowness"):
+    return _ev("rep.dist_evidence", seq, target=target, source=source,
+               fault=0.7)
+
+
+def _quar(seq, target=2, scope="peer", frm="suspect"):
+    return _ev("rep.transition", seq, client=target, scope=scope,
+               **{"from": frm}, to="quarantined", trust=0.1)
+
+
+def _needles():
+    """(name, events, expected slowness_is_not_malice fires)."""
+    return [
+        ("slowness_only_quarantine_fires",
+         [_slow_ev(0), _quar(1)], 1),
+        ("no_evidence_at_all_fires",
+         [_quar(0)], 1),
+        ("malice_evidence_authorizes",
+         [_slow_ev(0), _slow_ev(1, source="robust_outlier"), _quar(2)], 0),
+        ("restored_redeclaration_exempt",
+         [_slow_ev(0), _quar(1, frm="restored")], 0),
+        ("client_scope_out_of_jurisdiction",
+         [_slow_ev(0), _quar(1, scope="client")], 0),
+        ("wrong_target_does_not_authorize",
+         [_slow_ev(0), _slow_ev(1, target=3, source="ledger_auth"),
+          _quar(2, target=2)], 1),
+        ("evidence_after_transition_too_late",
+         [_quar(0), _slow_ev(1, source="ledger_auth")], 1),
+    ]
+
+
+@pytest.mark.parametrize("name,events,fires",
+                         _needles(), ids=[c[0] for c in _needles()])
+def test_slowness_invariant_batch_and_streaming_agree(name, events, fires):
+    batch = slowness_is_not_malice(events)
+    assert len(batch) == fires, (name, batch)
+    s = SSlownessIsNotMalice()
+    for e in events:
+        s.feed(e)
+    assert s.finalize() == batch, name
+
+
+# ------------------------------------------------------ loopback integration
+
+
+def test_three_peer_loopback_limping_peer_never_quarantined(tmp_path):
+    """The tentpole end to end on CPU loopback: peer 2 limps (seeded
+    train-seam stalls + direction-keyed link throttle) for the whole
+    run. Gates: the federation completes; limp injections and phi
+    samples are in the streams; the limper is down-weighted through the
+    w_slow lane but NEVER quarantined; and the collated invariant suite
+    — slowness_is_not_malice included — is clean."""
+    from bcfl_tpu.config import FedConfig, PartitionConfig
+    from bcfl_tpu.dist.harness import run_dist
+    from bcfl_tpu.reputation import ReputationConfig
+    from bcfl_tpu.telemetry import collate, read_stream
+
+    cfg = FedConfig(
+        name="gray_loopback", runtime="dist", mode="server",
+        sync="async", model="tiny-bert", dataset="synthetic",
+        num_clients=6, num_rounds=2, seq_len=16, batch_size=4,
+        max_local_batches=2, eval_every=0, seed=11,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+        reputation=ReputationConfig(enabled=True),
+        faults=FaultPlan(seed=11, limp_peers=(2,), limp_prob=0.8,
+                         limp_stall_s=0.4, limp_throttle_bps=262144.0),
+        dist=DistConfig(peers=3, buffer_timeout_s=5.0, idle_timeout_s=90.0,
+                        peer_deadline_s=280.0),
+    )
+    run_dir = str(tmp_path / "gray_loopback")
+    res = run_dist(cfg, run_dir, deadline_s=320.0, platform="cpu")
+    assert res["ok"], (res["returncodes"], res["log_tails"])
+    evs = [e for p in res["event_streams"] for e in read_stream(p)[0]]
+    limps = [e for e in evs if e["ev"] == "limp.inject"]
+    assert limps, "the armed limp lane never injected"
+    assert {e["kind"] for e in limps} <= {"stall", "throttle"}
+    assert "stall" in {e["kind"] for e in limps}
+    assert any(e["ev"] == "detector.phi" for e in evs), \
+        "no phi samples reached the stream"
+    quarantines = [e for e in evs
+                   if e["ev"] == "rep.transition"
+                   and e.get("to") == "quarantined"
+                   and e.get("scope") == "peer"]
+    assert quarantines == [], quarantines
+    for p, rep in res["reports"].items():
+        assert rep["status"] == "ok", (p, rep)
+    col = collate(res["event_streams"])
+    assert col["ok"], col["violations"]
+    assert "slowness_is_not_malice" in col["invariants"]
+    assert col["invariants"]["slowness_is_not_malice"] == 0

@@ -55,14 +55,19 @@ def _quarantined_merge_run():
     """A leader that merges an arrival from a peer its own tracker holds
     QUARANTINED at merge time (scope='peer' — the dist lane)."""
     return tt._clean_run() + [
-        _ev("rep.evidence", "B", 5, 12.5, client="A", fault=1.0),
-        _ev("rep.transition", "B", 6, 12.6, client="A", trust=0.05,
+        # real dist streams carry the tracker's evidence row (every fault
+        # path goes through _note) — required so slowness_is_not_malice
+        # sees a non-slowness cause for the quarantine below
+        _ev("rep.dist_evidence", "B", 5, 12.4, target="A",
+            source="ledger_auth", fault=1.0),
+        _ev("rep.evidence", "B", 6, 12.5, client="A", fault=1.0),
+        _ev("rep.transition", "B", 7, 12.6, client="A", trust=0.05,
             scope="peer", **{"from": "suspect", "to": "quarantined"}),
-        _merge("B", 7, 13.0, version=3, arrivals=[_arrival("A", 2)],
+        _merge("B", 8, 13.0, version=3, arrivals=[_arrival("A", 2)],
                component=["A", "B"], chain_len=6, head8="cc",
                rewrite=False),
         _send("A", 2, 12.8, to="B", msg_id=2),
-        _recv("B", 8, 12.9, src="A", msg_id=2),
+        _recv("B", 9, 12.9, src="A", msg_id=2),
     ]
 
 
@@ -141,6 +146,27 @@ def _fixtures():
 
     out.append(("quarantined_merge", _quarantined_merge_run(),
                 {"no_quarantined_merge"}))
+
+    # gray-failure lane (ROBUSTNESS.md §11): a peer-scoped quarantine whose
+    # only dist evidence is the phi estimator's slowness feed is the exact
+    # bug the lane forbids — slow must never be treated as malicious. The
+    # rep.evidence row keeps quarantine_evidence silent so the new rule
+    # fires alone; the legal twin adds one non-slowness evidence row.
+    slow_ev = _ev("rep.dist_evidence", "B", 5, 12.4, target="A",
+                  source="slowness", fault=0.4, slow=0.4)
+    slow_rep = _ev("rep.evidence", "B", 6, 12.5, client="A", fault=1.0)
+    slow_trans = _ev("rep.transition", "B", 7, 12.6, client="A",
+                     trust=0.05, scope="peer",
+                     **{"from": "suspect", "to": "quarantined"})
+    out.append(("slowness_only_quarantine",
+                tt._clean_run() + [slow_ev, slow_rep, slow_trans],
+                {"slowness_is_not_malice"}))
+    malice_ev = _ev("rep.dist_evidence", "B", 6, 12.45, target="A",
+                    source="robust_outlier", fault=1.0)
+    out.append(("slowness_plus_malice_quarantine",
+                tt._clean_run() + [slow_ev, malice_ev,
+                                   dict(slow_rep, seq=7),
+                                   dict(slow_trans, seq=8)], set()))
 
     # storage-repair lanes (ROBUSTNESS.md §10): an adopt must consume a
     # verified-ok STATE_SYNC in its own incarnation...

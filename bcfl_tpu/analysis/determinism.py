@@ -42,6 +42,16 @@ SEEDED_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     "dist/byzantine.py": None,
     # codec stochastic rounding / chunk grids (bit-identical encode pins)
     "compression/codecs.py": None,
+    # the codec's Pallas kernels: they consume the precomputed stochastic-
+    # rounding uniforms as an input operand (never draw RNG themselves) and
+    # their outputs sit under the same bit-identical encode pins — so the
+    # whole module is held to the no-wall-clock / no-global-RNG /
+    # no-unsorted-iteration contract
+    "ops/pallas_codec.py": None,
+    # the kernel harness: impl resolution decides WHICH kernel encodes a
+    # payload — the decision must be a pure function of (registry, impl,
+    # backend), never of host timing or iteration order
+    "ops/registry.py": None,
     # robust merge: vote order feeds krum selection + lineage records
     "dist/robust.py": None,
     # evidence aggregation order feeds the committed reputation rows

@@ -7,6 +7,7 @@ state, payload fingerprinting for the ledger, and bytes-on-wire accounting.
 """
 
 from bcfl_tpu.compression.codecs import (
+    KERNEL_IMPLS,
     KINDS,
     CompressionConfig,
     codec_key,
@@ -20,6 +21,7 @@ from bcfl_tpu.compression.codecs import (
 )
 
 __all__ = [
+    "KERNEL_IMPLS",
     "KINDS",
     "CompressionConfig",
     "codec_key",

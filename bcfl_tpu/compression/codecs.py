@@ -48,9 +48,20 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+# importing pallas_codec registers the codec kernel ops (int8_quantize,
+# topk_select, int8_dequant, topk_scatter) with the kernel harness
+from bcfl_tpu.ops import pallas_codec  # noqa: F401
+from bcfl_tpu.ops import registry
+
 Tree = Any
 
 KINDS = ("none", "int8", "topk", "int8+topk")
+
+#: kernel impl selection for the codec hot loop (PERF.md "Custom kernels"):
+#: "auto" = Pallas on TPU / XLA elsewhere, or force either. Every impl
+#: produces byte-identical payloads (the registry's declared parity for
+#: the codec ops), so this NEVER appears in :func:`wire_format`.
+KERNEL_IMPLS = registry.IMPLS
 
 # fold_in tag separating the codec's stochastic-rounding stream from the
 # training dropout stream derived from the same per-round key
@@ -73,6 +84,11 @@ class CompressionConfig:
     stochastic: bool = True
     # carry the per-client compression error into the next round's encode
     error_feedback: bool = True
+    # codec kernel impl: "auto" (Pallas on TPU, XLA elsewhere), "xla", or
+    # "pallas" (interpret mode off-TPU). Payload bytes are identical under
+    # every value — deliberately NOT part of wire_format(), so a resume
+    # may switch impls freely
+    kernel_impl: str = "auto"
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -83,6 +99,10 @@ class CompressionConfig:
         if not 0.0 < self.topk_frac <= 1.0:
             raise ValueError(
                 f"topk_frac must be in (0, 1], got {self.topk_frac}")
+        if self.kernel_impl not in KERNEL_IMPLS:
+            raise ValueError(
+                f"unknown kernel_impl {self.kernel_impl!r} "
+                f"(one of {KERNEL_IMPLS})")
 
     @property
     def enabled(self) -> bool:
@@ -121,23 +141,11 @@ def _int8_parts(y: jnp.ndarray, chunk: int, key,
     return q, scale.astype(jnp.float32)
 
 
-def _int8_merge(q: jnp.ndarray, scale: jnp.ndarray, n: int) -> jnp.ndarray:
-    """(q, scale) -> [C, N] f32 (padding stripped)."""
-    y = q.astype(jnp.float32) * scale[..., None]
-    return y.reshape(q.shape[0], -1)[:, :n]
-
-
 def _topk_parts(y: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """[C, N] f32 -> (val f32 [C, k], idx int32 [C, k]) by |value|."""
     _, idx = jax.lax.top_k(jnp.abs(y), k)
     val = jnp.take_along_axis(y, idx, axis=1)
     return val, idx.astype(jnp.int32)
-
-
-def _topk_scatter(val: jnp.ndarray, idx: jnp.ndarray, n: int) -> jnp.ndarray:
-    C, k = val.shape
-    out = jnp.zeros((C, n), jnp.float32)
-    return out.at[jnp.arange(C)[:, None], idx].set(val)
 
 
 def _encode_leaf(comp: CompressionConfig, y: jnp.ndarray, key) -> dict:
@@ -158,15 +166,22 @@ def _encode_leaf(comp: CompressionConfig, y: jnp.ndarray, key) -> dict:
 
 
 def _decode_leaf(comp: CompressionConfig, part: dict, n: int) -> jnp.ndarray:
-    """payload part -> [C, N] f32."""
+    """payload part -> [C, N] f32. Decode selection goes through the same
+    kernel registry (``int8_dequant`` / ``topk_scatter`` are registered
+    XLA-only, so any ``kernel_impl`` degrades to the reference — "reject
+    nothing")."""
     if comp.kind == "int8":
-        return _int8_merge(part["q"], part["s"], n)
+        return _run_op("int8_dequant", comp.kernel_impl,
+                       part["q"], part["s"], n=n)
     if comp.kind == "topk":
-        return _topk_scatter(part["v"], part["i"], n)
+        return _run_op("topk_scatter", comp.kernel_impl,
+                       part["v"], part["i"], n=n)
     if comp.kind == "int8+topk":
         k = part["i"].shape[1]
-        val = _int8_merge(part["q"], part["s"], k)
-        return _topk_scatter(val, part["i"], n)
+        val = _run_op("int8_dequant", comp.kernel_impl,
+                      part["q"], part["s"], n=k)
+        return _run_op("topk_scatter", comp.kernel_impl,
+                       val, part["i"], n=n)
     raise ValueError(f"unknown compression kind {comp.kind!r}")
 
 
@@ -199,7 +214,23 @@ def encode_tree_unfused(comp: CompressionConfig, delta: Tree, key) -> dict:
     return out
 
 
-def _int8_parts_batched(ys, keys, chunk: int, stochastic: bool):
+def _run_op(name: str, impl: str, *args, **kwargs):
+    """Resolve a codec kernel op through the harness and run it. A Pallas
+    impl that declines the shape (``NotImplementedError`` — e.g. a top-k
+    row wider than the single-block VMEM budget) degrades to the XLA
+    reference for that group: the declared parity is bit-identical, so the
+    fallback is invisible on the wire."""
+    fn, resolved = registry.resolve(name, impl)
+    if resolved == "pallas":
+        try:
+            return fn(*args, **kwargs)
+        except NotImplementedError:
+            return registry.get_op(name).xla(*args, **kwargs)
+    return fn(*args, **kwargs)
+
+
+def _int8_parts_batched(ys, keys, chunk: int, stochastic: bool,
+                        impl: str = "xla"):
     """Fused int8 quantize over several [C, N_i] leaves sharing one chunk
     size: each leaf is padded to its chunk grid exactly as
     :func:`_int8_parts` would, the grids are CONCATENATED along the chunk
@@ -207,6 +238,13 @@ def _int8_parts_batched(ys, keys, chunk: int, stochastic: bool):
     union — per-chunk groupings (and the per-leaf stochastic-rounding
     uniforms, drawn under each leaf's own fold_in key) are unchanged, so
     the split-back parts are bit-identical to the per-leaf encode.
+
+    The quantize pipeline itself runs through the kernel registry
+    (``int8_quantize``: XLA reference or the fused-VMEM-pass Pallas kernel
+    of :mod:`bcfl_tpu.ops.pallas_codec`, selected by ``impl``). The
+    stochastic-rounding uniforms are ALWAYS drawn here, outside the
+    kernel, under each leaf's own key — the kernel receives them as an
+    operand, so impl selection never touches the draw stream.
 
     Returns [(q, scale)] in input order."""
     grids, Ms = [], []
@@ -219,18 +257,14 @@ def _int8_parts_batched(ys, keys, chunk: int, stochastic: bool):
         grids.append(y.reshape(C, M, chunk))
         Ms.append(M)
     g = jnp.concatenate(grids, axis=1)  # [C, sum(M), chunk]
-    scale = jnp.max(jnp.abs(g), axis=-1) / 127.0
-    z = g / jnp.maximum(scale, 1e-30)[..., None]
+    u = None
     if stochastic:
         # per-leaf uniforms under each leaf's own key (the identity with
         # the unfused path), concatenated along the same chunk axis
         u = jnp.concatenate(
             [jax.random.uniform(k, grid.shape)
              for k, grid in zip(keys, grids)], axis=1)
-        z = jnp.floor(z + u)
-    else:
-        z = jnp.round(z)
-    q = jnp.clip(z, -127.0, 127.0).astype(jnp.int8)
+    q, scale = _run_op("int8_quantize", impl, g, u, stochastic=stochastic)
     out, off = [], 0
     for M in Ms:
         out.append((q[:, off:off + M], scale[:, off:off + M]
@@ -239,17 +273,18 @@ def _int8_parts_batched(ys, keys, chunk: int, stochastic: bool):
     return out
 
 
-def _topk_parts_batched(ys, k: int):
+def _topk_parts_batched(ys, k: int, impl: str = "xla"):
     """Fused top-k over several [C, N] leaves of ONE flattened width:
-    stacked to [L*C, N], a single ``lax.top_k`` sorts every row — top_k is
-    row-independent, so each leaf's (val, idx) rows are bit-identical to
-    its standalone call. Returns [(val, idx)] in input order."""
+    stacked to [L*C, N], a single magnitude-select sorts every row — the
+    selection is row-independent, so each leaf's (val, idx) rows are
+    bit-identical to its standalone call. The select runs through the
+    kernel registry (``topk_select``: ``lax.top_k`` reference or the
+    row-blocked Pallas kernel, which reproduces lax.top_k's tie-breaking
+    exactly). Returns [(val, idx)] in input order."""
     L = len(ys)
     C, N = ys[0].shape
     stacked = jnp.concatenate(ys, axis=0)  # [L*C, N]
-    _, idx = jax.lax.top_k(jnp.abs(stacked), k)
-    val = jnp.take_along_axis(stacked, idx, axis=1)
-    idx = idx.astype(jnp.int32)
+    val, idx = _run_op("topk_select", impl, stacked, k=k)
     return [(val[i * C:(i + 1) * C], idx[i * C:(i + 1) * C])
             for i in range(L)]
 
@@ -294,7 +329,8 @@ def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
         # index either way, but the trace/draw order stays host-invariant
         for n, group in sorted(by_n.items()):
             parts = _topk_parts_batched([ys[i] for i in group],
-                                        _leaf_k(comp, n))
+                                        _leaf_k(comp, n),
+                                        impl=comp.kernel_impl)
             for i, (v, ix) in zip(group, parts):
                 vals[i], idxs[i] = v, ix
         if comp.kind == "topk":
@@ -309,12 +345,13 @@ def encode_tree(comp: CompressionConfig, delta: Tree, key) -> dict:
         for ck, group in sorted(by_ck.items()):  # same order contract
             parts = _int8_parts_batched(
                 [vals[i] for i in group], [keys[i] for i in group],
-                ck, comp.stochastic)
+                ck, comp.stochastic, impl=comp.kernel_impl)
             for i, (q, s) in zip(group, parts):
                 out[paths[i]] = {"q": q, "s": s, "i": idxs[i]}
         return out
     if comp.kind == "int8":
-        parts = _int8_parts_batched(ys, keys, comp.chunk, comp.stochastic)
+        parts = _int8_parts_batched(ys, keys, comp.chunk, comp.stochastic,
+                                    impl=comp.kernel_impl)
         for p, (q, s) in zip(paths, parts):
             out[p] = {"q": q, "s": s}
         return out
@@ -393,7 +430,11 @@ def wire_format(comp: Optional["CompressionConfig"]) -> str:
 
     Only the fields the kind actually CONSUMES are part of the identity —
     a pure-topk run resumed with a different int8 chunk size has an
-    unchanged encode, and refusing it would block a legitimate resume."""
+    unchanged encode, and refusing it would block a legitimate resume.
+    ``kernel_impl`` is deliberately EXCLUDED: every impl's payload is
+    byte-identical (the registry's bit-identical parity contract for the
+    codec ops), so resuming a TPU run on CPU — or forcing the Pallas
+    kernels mid-run — is always legitimate."""
     if comp is None or not comp.enabled:
         return "none"
     parts = [comp.kind]

@@ -775,7 +775,10 @@ class FedConfig:
     # deltas with error-feedback residuals, compiled INTO the round
     # programs. 'none' (default) is bit-identical to the uncompressed
     # programs. gspmd impl only; the faithful host-sequential mode has no
-    # transport stage to compress (rejected below).
+    # transport stage to compress (rejected below). kernel_impl ∈
+    # auto/xla/pallas selects the codec kernels (PERF.md "Custom
+    # kernels"); every impl's payload is byte-identical, so it never
+    # affects wire bytes, digests, or resume.
     compression: CompressionConfig = dataclasses.field(
         default_factory=CompressionConfig)
 

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from bcfl_tpu.ops import registry
+
 DEFAULT_BLOCK = 512
 
 
@@ -115,18 +117,41 @@ def flash_attention_pallas(q, k, v, bias=None, causal: bool = False,
 
 _pallas_fallback_warned = False
 
+# registry entry (PERF.md "Custom kernels"): flash is the harness's
+# tolerance-parity client — online-softmax reassociation makes the Pallas
+# and XLA paths numerically close, not bit-identical (the pin lives in
+# tests/test_pallas_kernels.py). The codec ops are the bit-identical ones.
+FLASH_ATTENTION = registry.register_op(registry.KernelOp(
+    name="flash_attention",
+    xla=flash_attention_xla,
+    pallas=flash_attention_pallas,
+    parity="allclose:2e-2 (online-softmax reassociation; "
+           "pinned in tests/test_pallas_kernels.py)",
+    bench_shapes=(
+        {"label": "bert-base-B4-S512", "B": 4, "H": 12, "S": 512, "D": 64},
+        {"label": "llama-decode-B1-S2048", "B": 1, "H": 8, "S": 2048,
+         "D": 64},
+    ),
+))
+
 
 def flash_attention(q, k, v, bias=None, causal: bool = False,
                     block_size: int = DEFAULT_BLOCK):
-    """Dispatch: Pallas on TPU when available, XLA blockwise elsewhere.
+    """Dispatch: Pallas on TPU when available, XLA blockwise elsewhere —
+    impl selection through the kernel registry (``resolve("auto")`` =
+    pallas iff the backend is a TPU), with the warn-once degradation kept
+    here: an unsupported shape/bias falls back to the XLA reference.
 
     ``bias`` here is key-side only ([B, Sk] or [B, 1, 1, Sk]) so both paths
     stay O(S) in memory; use :func:`flash_attention_xla` directly for an
     arbitrary dense bias.
     """
     global _pallas_fallback_warned
-    if jax.default_backend() == "tpu":
+    _, impl = registry.resolve("flash_attention", "auto")
+    if impl == "pallas":
         try:
+            # the module global (not the registry's captured callable), so
+            # tests can monkeypatch the kernel under the dispatcher
             return flash_attention_pallas(q, k, v, bias, causal=causal)
         except (ValueError, NotImplementedError, TypeError,
                 jax.errors.JaxRuntimeError) as e:

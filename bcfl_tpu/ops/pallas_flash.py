@@ -47,13 +47,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bcfl_tpu.ops import registry
+
 NEG_INF = -1e30  # large-negative, not -inf: exp underflows to 0 without NaNs
 LANES = 128  # TPU lane width: scratch/lse last dim must be 128
 
 
 def _interpret() -> bool:
-    """Run kernels in interpret mode off-TPU (CPU CI) — same kernel bodies."""
-    return jax.default_backend() != "tpu"
+    """Run kernels in interpret mode off-TPU (CPU CI) — same kernel bodies.
+    Delegates to the shared harness knob (``BCFL_PALLAS_INTERPRET``,
+    :func:`bcfl_tpu.ops.registry.interpret_mode`) so one toggle governs
+    every kernel; kept as a name because callers/tests import it here."""
+    return registry.interpret_mode()
 
 
 def _zero_oob_rows(x, start: int, limit: int):
@@ -132,18 +137,12 @@ def _block_sizes(block_q: int, block_k: int, S: int, Sk: int):
     last two dims of every block must divide (8, 128) or equal the array
     dims. bq tiles a sublane-adjacent dim (multiple of 8); bk tiles the
     bias lane dim (multiple of 128). A caller's odd block size becomes the
-    nearest legal one instead of an obscure lowering error on silicon."""
-
-    def legal(b, dim, unit):
-        b = min(b, dim)
-        if b == dim or b % unit == 0:
-            return b
-        b = (b // unit) * unit
-        # floor hit zero: the nearest legal block is one tile — or the
-        # whole (smaller-than-a-tile) dim, which is pad-free AND legal
-        return b if b >= unit else min(unit, dim)
-
-    return legal(block_q, S, 8), legal(block_k, Sk, LANES)
+    nearest legal one instead of an obscure lowering error on silicon.
+    The rule now lives in the shared harness
+    (:func:`bcfl_tpu.ops.registry.legal_block_sizes`); this name stays as
+    the flash-specific binding callers/tests import."""
+    return registry.legal_block_sizes(
+        ((block_q, S, registry.SUBLANES), (block_k, Sk, LANES)))
 
 
 def _flash_fwd_pallas(q, k, v, key_bias, causal: bool,

@@ -1,0 +1,182 @@
+"""Kernel harness: one registry for every hand-written kernel (PERF.md
+"Custom kernels").
+
+The repo's first Pallas kernel (:mod:`bcfl_tpu.ops.pallas_flash`) grew its
+own interpret-mode toggle, block-size clamping, impl dispatch, and parity
+pinning; the second kernel (the codec, :mod:`bcfl_tpu.ops.pallas_codec`)
+would have duplicated all four. This module extracts that machinery so a new
+kernel is one :class:`KernelOp` registration away:
+
+- **registry** — named ops, each with an XLA reference impl and an optional
+  Pallas impl. Unknown names are rejected loudly (:func:`get_op`); an op
+  WITHOUT a Pallas impl serves its XLA reference under every ``impl``
+  request ("reject nothing": selection degrades, it never errors).
+- **impl selection** (:func:`resolve`) — ``impl="xla" | "pallas" | "auto"``;
+  ``auto`` = Pallas on a real TPU backend, XLA elsewhere. An explicit
+  ``"pallas"`` off-TPU runs the kernel body in interpret mode, so CI
+  exercises the exact kernel everywhere (SURVEY.md §4's
+  distributed-without-hardware strategy applied to kernels).
+- **one interpret-mode knob** (:func:`interpret_mode`) —
+  ``BCFL_PALLAS_INTERPRET=1|0`` overrides the backend auto-detection for
+  EVERY kernel; the pre-harness per-kernel variable is honored as a
+  deprecated alias.
+- **block legalization** (:func:`legal_block` / :func:`legal_block_sizes`)
+  — the (8, 128) Mosaic divisibility rule, generalized: real-TPU Mosaic
+  requires the last two dims of every block to divide the dtype's
+  (sublane, lane) tile — (8, 128) for f32 — or EQUAL the array dims
+  (PERF.md documents this biting on silicon once already; interpret mode
+  never checks it).
+- **parity contract** — each op declares how closely the Pallas impl must
+  match the XLA reference (``parity="bit-identical"`` or a pinned
+  tolerance string). The contract is what tests pin and what
+  ``scripts/kernel_bench.py`` verifies before it times anything.
+- **microbench shapes** — each op may declare the real shapes it is paid
+  at; ``scripts/kernel_bench.py`` sweeps exactly those rows.
+
+Ops registered day one: ``flash_attention`` (:mod:`bcfl_tpu.ops.flash`,
+tolerance parity — online-softmax reassociation) and the codec's
+``int8_quantize`` / ``topk_select`` / ``int8_dequant`` / ``topk_scatter``
+(:mod:`bcfl_tpu.ops.pallas_codec` via
+:mod:`bcfl_tpu.compression.codecs`, bit-identical parity — ledger digests
+chain over the encoded payload, so anything weaker would fork the chain).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+#: the one interpret-mode knob (satellite of ISSUE 19): "1"/"true" forces
+#: interpret mode even on TPU (kernel-body debugging on silicon hosts),
+#: "0"/"false" forces compiled Mosaic lowering, unset = auto (interpret
+#: off-TPU so CPU CI runs the exact kernel bodies).
+INTERPRET_ENV = "BCFL_PALLAS_INTERPRET"
+#: pre-harness spelling (pallas_flash's private toggle); honored with a
+#: DeprecationWarning so existing driver scripts keep working one cycle.
+INTERPRET_ENV_DEPRECATED = "BCFL_FLASH_INTERPRET"
+
+IMPLS = ("auto", "xla", "pallas")
+
+#: f32 Mosaic tile: last two block dims must divide (8, 128) or equal the
+#: array dims. (bf16 wants 16 sublanes, int8/fp8 32 — pass the unit that
+#: covers every dtype a block touches.)
+SUBLANES = 8
+LANES = 128
+
+
+def interpret_mode() -> bool:
+    """Should Pallas kernels run in interpret mode? One knob for every
+    kernel: ``BCFL_PALLAS_INTERPRET`` overrides, else interpret exactly
+    when the backend is not a TPU (same kernel bodies on the CPU mesh)."""
+    val = os.environ.get(INTERPRET_ENV)
+    if val is None:
+        old = os.environ.get(INTERPRET_ENV_DEPRECATED)
+        if old is not None:
+            warnings.warn(
+                f"{INTERPRET_ENV_DEPRECATED} is deprecated; use "
+                f"{INTERPRET_ENV} (one knob for every Pallas kernel)",
+                DeprecationWarning, stacklevel=2)
+            val = old
+    if val is not None and val != "":
+        return val.lower() not in ("0", "false", "no")
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------ block sizing
+
+
+def legal_block(requested: int, dim: int, unit: int) -> int:
+    """Clamp one requested block extent to what real-TPU Mosaic accepts:
+    either a multiple of ``unit`` (the sublane/lane tile for that axis and
+    dtype) or the whole array dim. A caller's odd block size becomes the
+    nearest legal one instead of an obscure lowering error on silicon
+    (generalized from ``pallas_flash._block_sizes``)."""
+    b = min(requested, dim)
+    if b == dim or b % unit == 0:
+        return b
+    b = (b // unit) * unit
+    # floor hit zero: the nearest legal block is one tile — or the whole
+    # (smaller-than-a-tile) dim, which is pad-free AND legal
+    return b if b >= unit else min(unit, dim)
+
+
+def legal_block_sizes(
+        requests: Tuple[Tuple[int, int, int], ...]) -> Tuple[int, ...]:
+    """Vector form: ``((requested, dim, unit), ...)`` -> legal extents."""
+    return tuple(legal_block(b, d, u) for b, d, u in requests)
+
+
+# ---------------------------------------------------------------- registry
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelOp:
+    """One named op: the XLA reference is the semantic ground truth; the
+    Pallas impl must match it to ``parity``. ``bench_shapes`` are the
+    real shapes the op is paid at (label -> args builder kwargs), swept by
+    ``scripts/kernel_bench.py``."""
+
+    name: str
+    xla: Callable
+    pallas: Optional[Callable] = None
+    #: "bit-identical" or a pinned-tolerance note (e.g. "allclose:2e-2").
+    #: Bit-identical ops may sit under wire digests; tolerance ops may not.
+    parity: str = "bit-identical"
+    #: static description of the microbench sweep, op-specific format
+    bench_shapes: Tuple = ()
+
+    @property
+    def has_pallas(self) -> bool:
+        return self.pallas is not None
+
+
+_REGISTRY: Dict[str, KernelOp] = {}
+
+
+def register_op(op: KernelOp) -> KernelOp:
+    """Register (idempotent per name+impls; a conflicting re-register is a
+    programming error and fails loudly)."""
+    prev = _REGISTRY.get(op.name)
+    if prev is not None and prev is not op and (
+            prev.xla is not op.xla or prev.pallas is not op.pallas):
+        raise ValueError(f"kernel op {op.name!r} already registered with "
+                         f"different impls")
+    _REGISTRY[op.name] = op
+    return op
+
+
+def get_op(name: str) -> KernelOp:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown kernel op {name!r}; registered ops: "
+            f"{sorted(_REGISTRY)} (register via "
+            f"bcfl_tpu.ops.registry.register_op)")
+    return _REGISTRY[name]
+
+
+def list_ops() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def resolve(name: str, impl: str = "auto") -> Tuple[Callable, str]:
+    """``(callable, resolved_impl)`` for an op under an impl request.
+
+    ``auto`` = pallas iff the op has a Pallas impl AND the backend is a
+    TPU; an explicit ``pallas`` request on an op with a Pallas impl runs
+    it even off-TPU (interpret mode — how tier-1 pins kernel parity). An
+    op without a Pallas impl serves its XLA reference under EVERY request:
+    selection never errors, payloads never change."""
+    op = get_op(name)
+    if impl not in IMPLS:
+        raise ValueError(f"unknown kernel impl {impl!r} for op {name!r} "
+                         f"(one of {IMPLS})")
+    if impl == "auto":
+        impl = ("pallas" if op.has_pallas
+                and jax.default_backend() == "tpu" else "xla")
+    if impl == "pallas" and not op.has_pallas:
+        impl = "xla"
+    return (op.pallas if impl == "pallas" else op.xla), impl

@@ -49,6 +49,10 @@ BCFL_BENCH_COMPRESS={none,int8,topk,int8+topk} compiles the update-exchange
 codec (COMPRESSION.md) into the timed round program and adds bytes-on-wire
 fields to the JSON line — the throughput-per-codec axis of the
 scripts/tpu_perf.py --compress sweep.
+BCFL_BENCH_CODEC_IMPL={auto,xla,pallas} selects the codec kernel impl
+(PERF.md "Custom kernels"; payloads byte-identical under every value) and
+stamps codec_impl plus a codec_encode_ms encode-only sub-timing, so a
+healthy TPU window records the XLA-vs-Pallas codec wall for free.
 BCFL_BENCH_LORA_RANK=<r> (r > 0) makes the LoRA adapter the trainable /
 exchanged tree (COMPRESSION.md "Adapter exchange"): the timed program
 fine-tunes rank-r adapters over the frozen base, and every JSON line —
@@ -83,6 +87,14 @@ DIST_DISPATCH = os.environ.get("BCFL_BENCH_DIST_DISPATCH", "leader")
 # backend-init watchdog is armed; tests/test_compression.py pins the copies
 COMPRESS_KINDS = ("none", "int8", "topk", "int8+topk")
 COMPRESS = os.environ.get("BCFL_BENCH_COMPRESS", "none")
+# codec kernel impl axis (PERF.md "Custom kernels"): auto | xla | pallas,
+# compiled into the timed program via CompressionConfig.kernel_impl and
+# stamped as codec_impl — the next healthy TPU window records XLA-vs-
+# Pallas encode walls on silicon with zero new code. Literal value set
+# (not compression.KERNEL_IMPLS) for the same no-import-before-watchdog
+# reason as COMPRESS_KINDS above.
+CODEC_IMPLS = ("auto", "xla", "pallas")
+CODEC_IMPL = os.environ.get("BCFL_BENCH_CODEC_IMPL", "auto")
 # adapter-exchange axis: rank 0 = full-model fine-tune (the default row);
 # kept as a raw string here and validated in main() so a typo still dies
 # through _error_json under the one-JSON-line contract
@@ -154,7 +166,36 @@ def _compress_cfg():
         return None
     from bcfl_tpu.compression import CompressionConfig
 
-    return CompressionConfig(kind=COMPRESS)
+    return CompressionConfig(kind=COMPRESS, kernel_impl=CODEC_IMPL)
+
+
+def _codec_encode_ms(comp, trainable0, num_clients: int) -> float:
+    """Sub-timing of the codec encode alone — the per-round hot loop the
+    Pallas kernels target (ops/pallas_codec.py). One jitted ``encode_tree``
+    over a [C, ...] stacked delta shaped like the exchanged tree, warmed
+    outside the timed window and fenced by host readback (core.fence —
+    ``jax.block_until_ready`` no-ops on the tunnelled backend)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bcfl_tpu.compression import codec_key, encode_tree
+    from bcfl_tpu.core.fence import fence
+
+    delta = jax.jit(lambda p: jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            x[None].astype(jnp.float32), (num_clients,) + x.shape), p))(
+        trainable0)
+    fence(delta)
+    keys = jax.random.split(jax.random.key(11), num_clients)
+    enc = jax.jit(lambda d, kk: encode_tree(comp, d, codec_key(kk)))
+    fence(enc(delta, keys))  # compile + one warm dispatch
+    iters = 3
+    t0 = time.perf_counter()
+    payload = None
+    for _ in range(iters):
+        payload = enc(delta, keys)
+    fence(payload)
+    return (time.perf_counter() - t0) / iters * 1000.0
 
 
 def _event_stream() -> str:
@@ -278,7 +319,8 @@ def _dist_bench(watchdog):
         eval_every=0, seed=42, lora_rank=lora_rank,
         partition=PartitionConfig(kind="iid", iid_samples=8),
         ledger=LedgerConfig(enabled=True),
-        compression=CompressionConfig(kind=COMPRESS),
+        compression=CompressionConfig(kind=COMPRESS,
+                                      kernel_impl=CODEC_IMPL),
         # dispatch="gossip" rides the same knobs; the fanout is clamped
         # below the fleet size (the config rejects fanout >= peers)
         dist=DistConfig(peers=peers, peer_deadline_s=deadline,
@@ -319,6 +361,7 @@ def _dist_bench(watchdog):
         "pipeline": pipeline,
         "dispatch": DIST_DISPATCH,
         "compress": COMPRESS,
+        "codec_impl": CODEC_IMPL,
         "target_versions": versions,
         "final_versions": {str(p): r.get("final_version")
                            for p, r in reports.items()},
@@ -370,6 +413,12 @@ def main():
         # uncompressed program under a compression label
         _error_json("config", f"unknown BCFL_BENCH_COMPRESS {COMPRESS!r}; "
                     "expected none/int8/topk/int8+topk")
+        sys.exit(1)
+    if CODEC_IMPL not in CODEC_IMPLS:
+        # fail-fast class as above: a typo'd impl would silently time the
+        # default kernels under a pallas/xla label
+        _error_json("config", f"unknown BCFL_BENCH_CODEC_IMPL {CODEC_IMPL!r}; "
+                    "expected auto/xla/pallas")
         sys.exit(1)
     if DIST_DISPATCH not in ("leader", "gossip"):
         _error_json("config", "unknown BCFL_BENCH_DIST_DISPATCH "
@@ -604,9 +653,16 @@ def main():
             raw_b = payload_nbytes(None, trainable0) * num_clients
             wire_b = payload_nbytes(comp, trainable0) * num_clients
             out["compress"] = COMPRESS
+            out["codec_impl"] = CODEC_IMPL
             out["bytes_raw_per_round"] = int(raw_b)
             out["bytes_on_wire_per_round"] = int(wire_b)
             out["compression_ratio"] = round(raw_b / max(wire_b, 1), 2)
+            if comp is not None:
+                # encode-only sub-wall: the row the kernel registry's
+                # XLA-vs-Pallas comparison reads on silicon
+                watchdog.stage("codec-encode")
+                out["codec_encode_ms"] = round(
+                    _codec_encode_ms(comp, trainable0, num_clients), 3)
         if lora_rank > 0:
             out["lora_rank"] = lora_rank
             out["adapter_params"] = int(adapter_params)

@@ -49,7 +49,10 @@ run_bench() {  # $1 = mode, $2 = out file, [$3 = extra env "K=V"]
   # Either way bench.py stamps bench_attempts/retry_backoff_s into the
   # JSON line, so a recorded zero is distinguishable from a never-retried
   # wedge.
-  BCFL_BENCH_RETRIES="${BCFL_BENCH_RETRIES:-0}" BCFL_BENCH_MODE="$1" ${3:+env "$3"} \
+  # BCFL_BENCH_CODEC_IMPL passes through explicitly (default auto) so a
+  # loop invocation can pin the codec kernel impl for a whole evidence run
+  BCFL_BENCH_RETRIES="${BCFL_BENCH_RETRIES:-0}" BCFL_BENCH_MODE="$1" \
+    BCFL_BENCH_CODEC_IMPL="${BCFL_BENCH_CODEC_IMPL:-auto}" ${3:+env "$3"} \
     timeout -k 10 7200 python bench.py > /tmp/bench_out_$1.txt 2>> "$LOG"
   cat /tmp/bench_out_$1.txt >> "$LOG"
   local line

@@ -1,0 +1,213 @@
+"""Pallas codec kernels + kernel harness contracts (PERF.md "Custom
+kernels").
+
+The codec's Pallas kernels (``bcfl_tpu.ops.pallas_codec``) run here in
+interpret mode on CPU — the exact kernel bodies, off silicon — and are
+held to their declared parity: **bit-identical** payloads against the
+per-leaf XLA reference encode, for every codec kind, stochastic and
+deterministic, across padded / odd-width / rank-2-adapter shapes.
+
+Both sides of every parity check are jitted: XLA:CPU strength-reduces
+``x / 127.0`` differently under jit than in eager (reciprocal-multiply vs
+IEEE divide, a 1-ULP scale difference), so bit-identity is defined — and
+production-relevant — within a compilation context. Round programs are
+always jitted; a receiver authenticates the bytes it received and never
+re-encodes, so cross-program identity is not a wire requirement.
+
+Harness contracts ride along: unknown ops reject loudly, ``kernel_impl``
+never reaches the wire format (resume may switch impls freely), the
+VMEM-budget decline degrades to the reference invisibly, and the
+interpret knob honors ``BCFL_PALLAS_INTERPRET`` with the old flash var as
+a deprecated alias.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bcfl_tpu.compression import (
+    CompressionConfig,
+    decode_tree,
+    encode_tree,
+    wire_format,
+)
+from bcfl_tpu.compression.codecs import encode_tree_unfused
+from bcfl_tpu.config import FedConfig, PartitionConfig
+from bcfl_tpu.fed.engine import FedEngine
+from bcfl_tpu.ops import pallas_codec, registry
+
+pytestmark = pytest.mark.compression
+
+
+def _tree(seed=0):
+    """Stacked [C=4, ...] leaves: chunk-padded odd widths, a bf16-typical
+    small vector, an exact-chunk-multiple leaf, and a rank-2 LoRA adapter
+    pair (in_features x r and r x out_features views, COMPRESSION.md) —
+    ties, zeros, and -0.0 included so tie-breaking and sign-preserving
+    select are exercised."""
+    k = jax.random.key(seed)
+    t = {
+        "w": jax.random.normal(jax.random.fold_in(k, 1), (4, 37, 5)) * 3.0,
+        "b": jax.random.normal(jax.random.fold_in(k, 2), (4, 9)),
+        "exact": jax.random.normal(jax.random.fold_in(k, 3), (4, 64)),
+        "lora_a": jax.random.normal(jax.random.fold_in(k, 4), (4, 48, 2)),
+        "lora_b": jax.random.normal(jax.random.fold_in(k, 5), (4, 2, 48)),
+    }
+    w = np.array(t["w"])
+    w[0, 0, :4] = [0.5, 0.5, -0.5, 0.0]  # magnitude ties + an exact zero
+    w[1, 0, :2] = [-0.0, 0.0]            # signed zeros survive the select
+    t["w"] = jnp.asarray(w)
+    return t
+
+
+def _jit_encode(fn, comp):
+    return jax.jit(lambda d, k: fn(comp, d, k))
+
+
+@pytest.mark.parametrize("kind", ["int8", "topk", "int8+topk"])
+@pytest.mark.parametrize("stochastic", [False, True])
+def test_pallas_encode_bit_identical(kind, stochastic):
+    """kernel_impl="pallas" (interpret mode here) must produce payloads
+    BIT-identical to the per-leaf pure-XLA reference encode — same dtypes,
+    same bits, so ledger digests and checkpointed EF state cannot move
+    with impl selection."""
+    ref_comp = CompressionConfig(kind=kind, chunk=16, topk_frac=0.3,
+                                 stochastic=stochastic)
+    pl_comp = CompressionConfig(kind=kind, chunk=16, topk_frac=0.3,
+                                stochastic=stochastic, kernel_impl="pallas")
+    tree, key = _tree(), jax.random.key(7)
+    a = _jit_encode(encode_tree_unfused, ref_comp)(tree, key)
+    b = _jit_encode(encode_tree, pl_comp)(tree, key)
+    assert (jax.tree_util.tree_structure(a)
+            == jax.tree_util.tree_structure(b))
+    for (pa, xa), (_, xb) in zip(
+            jax.tree_util.tree_flatten_with_path(a)[0],
+            jax.tree_util.tree_flatten_with_path(b)[0]):
+        assert np.asarray(xa).dtype == np.asarray(xb).dtype, pa
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb),
+                                      err_msg=str(pa))
+    # and every impl decodes the same payload to the same tree
+    dec_auto = decode_tree(ref_comp, b, tree)
+    dec_pl = decode_tree(pl_comp, b, tree)
+    for xa, xb in zip(jax.tree.leaves(dec_auto), jax.tree.leaves(dec_pl)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+@pytest.mark.parametrize("impl", ["auto", "xla", "pallas"])
+def test_every_impl_same_payload(impl):
+    """The three selectable impls agree bit-for-bit on one jitted encode
+    (int8+topk, stochastic — the full pipeline)."""
+    comp = CompressionConfig(kind="int8+topk", chunk=16, topk_frac=0.25,
+                             stochastic=True, kernel_impl=impl)
+    ref = CompressionConfig(kind="int8+topk", chunk=16, topk_frac=0.25,
+                            stochastic=True)  # default auto
+    tree, key = _tree(3), jax.random.key(5)
+    a = _jit_encode(encode_tree, ref)(tree, key)
+    b = _jit_encode(encode_tree, comp)(tree, key)
+    for xa, xb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_topk_vmem_decline_degrades_to_reference():
+    """A top-k row wider than the single-block VMEM budget makes the
+    Pallas kernel raise NotImplementedError BEFORE launch; the codec's
+    _run_op falls back to the XLA reference, bit-identically — the decline
+    is invisible on the wire."""
+    n = pallas_codec.TOPK_VMEM_BUDGET_BYTES  # any N past budget/(4*6*br)
+    x = jax.random.normal(jax.random.key(0), (8, 60_000), jnp.float32)
+    assert 8 * 60_000 * 4 * pallas_codec._TOPK_LIVE_BUFFERS > n
+    with pytest.raises(NotImplementedError, match="VMEM"):
+        pallas_codec._topk_select_pallas(x, k=5)
+    from bcfl_tpu.compression.codecs import _run_op
+    va, ia = jax.jit(lambda y: _run_op("topk_select", "xla", y, k=5))(x)
+    vb, ib = jax.jit(lambda y: _run_op("topk_select", "pallas", y, k=5))(x)
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+
+
+# ----------------------------------------------------------------- harness
+
+
+def test_registry_rejects_undeclared_op():
+    """Unknown op names reject loudly (the "reject nothing" rule is about
+    impl degradation, never about typo'd ops); unknown impls too."""
+    with pytest.raises(KeyError, match="unknown kernel op"):
+        registry.resolve("definitely_not_registered")
+    with pytest.raises(KeyError, match="int8_quantize"):
+        # the error names the registered ops, so the typo is debuggable
+        registry.get_op("int8_quantize_v2")
+    with pytest.raises(ValueError, match="impl"):
+        registry.resolve("int8_quantize", "cuda")
+    with pytest.raises(ValueError, match="kernel_impl"):
+        CompressionConfig(kind="int8", kernel_impl="cuda")
+
+
+def test_registry_degrades_pallas_to_xla_for_xla_only_ops():
+    """Explicit kernel_impl="pallas" on an op with no Pallas impl serves
+    the XLA reference (decode-side ops are registered XLA-only)."""
+    fn, resolved = registry.resolve("int8_dequant", "pallas")
+    assert resolved == "xla"
+    assert fn is registry.get_op("int8_dequant").xla
+    # auto off-TPU is XLA even when a Pallas impl exists
+    _, resolved = registry.resolve("int8_quantize", "auto")
+    assert resolved == ("pallas" if jax.default_backend() == "tpu"
+                        else "xla")
+
+
+def test_interpret_knob_and_deprecated_alias(monkeypatch):
+    monkeypatch.delenv(registry.INTERPRET_ENV, raising=False)
+    monkeypatch.delenv(registry.INTERPRET_ENV_DEPRECATED, raising=False)
+    # auto: interpret everywhere but on a real TPU backend
+    assert registry.interpret_mode() == (jax.default_backend() != "tpu")
+    monkeypatch.setenv(registry.INTERPRET_ENV, "0")
+    assert registry.interpret_mode() is False
+    monkeypatch.setenv(registry.INTERPRET_ENV, "1")
+    assert registry.interpret_mode() is True
+    monkeypatch.delenv(registry.INTERPRET_ENV)
+    monkeypatch.setenv(registry.INTERPRET_ENV_DEPRECATED, "1")
+    with pytest.warns(DeprecationWarning, match="BCFL_PALLAS_INTERPRET"):
+        assert registry.interpret_mode() is True
+
+
+def test_legal_block_sizes():
+    """The shared Mosaic legalization: a block divides into the dim on the
+    tile unit, or IS the dim (then any size is legal)."""
+    assert registry.legal_block(256, 1024, 128) == 256
+    assert registry.legal_block(2048, 1024, 128) == 1024  # clamp to dim
+    assert registry.legal_block(200, 1024, 128) == 128    # floor to unit
+    assert registry.legal_block(37, 37, 128) == 37        # == dim: legal
+    assert registry.legal_block(64, 100, 128) == 100      # sub-unit dim
+    assert registry.legal_block_sizes(
+        ((512, 128, 8), (512, 384, 128))) == (128, 384)
+
+
+# ------------------------------------------------------------ engine seam
+
+
+def _tiny(**kw):
+    base = dict(
+        dataset="synthetic", model="tiny-bert", num_clients=4, num_rounds=2,
+        seq_len=16, batch_size=4, max_local_batches=2, vocab_size=512,
+        partition=PartitionConfig(kind="iid", iid_samples=8),
+    )
+    base.update(kw)
+    return FedConfig(**base)
+
+
+def test_kernel_impl_excluded_from_wire_format_and_resume(tmp_path):
+    """kernel_impl is NOT codec identity: every impl's payload is byte-
+    identical, so (a) wire_format strings are equal across impls and (b) a
+    checkpointed run resumes under a DIFFERENT kernel_impl without the
+    wire-format refusal — unlike a kind/chunk/topk_frac change."""
+    comps = [CompressionConfig(kind="int8+topk", topk_frac=0.1,
+                               kernel_impl=i) for i in ("auto", "xla",
+                                                        "pallas")]
+    assert len({wire_format(c) for c in comps}) == 1
+    kw = dict(checkpoint_dir=str(tmp_path), checkpoint_every=1,
+              eval_every=0)
+    FedEngine(_tiny(num_rounds=1, compression=comps[1], **kw)).run()
+    res = FedEngine(_tiny(num_rounds=2, compression=comps[2],
+                          **kw)).run(resume=True)
+    assert len(res.metrics.rounds) == 1  # resumed past round 0, no refusal

@@ -68,7 +68,8 @@ SEEDED_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     # GossipPeerRuntime class around them is wall-clock country
     # (hello cadence, drain windows, arrival latencies)
     "dist/gossip.py": ("sample_neighbors", "hedge_neighbors",
-                       "merge_states", "state_digest", "_walk_sorted"),
+                       "probe_targets", "merge_states", "state_digest",
+                       "_walk_sorted"),
 }
 
 _WALLCLOCK = {"time", "monotonic", "time_ns", "monotonic_ns",

@@ -546,11 +546,21 @@ RUNTIME_CAPS: Tuple = (
               "verbatim — use trimmed_mean or median"}),
     ("chaos: transport partition under gossip dispatch",
      lambda c: c.faults.partitions and c.dist.dispatch == "gossip",
-     {"local": True,
-      "dist": "the partition fork/reconcile heal protocol is a leadered "
-              "construct (peer 0 arbitrates the reconcile); gossip "
-              "handles unreachable peers through detector-driven "
-              "membership instead — drop partitions from the fault plan"}),
+     {"local": True, "dist": True}),  # dist: supported LEADERLESSLY
+    # (RUNTIME.md §9, ROBUSTNESS.md §6): during the span each component
+    # keeps converging on its own clocks — neighbor draws stay inside
+    # the gate component, the merge seam rejects frames buffered across
+    # the cut (the gossip scope of no_cross_partition_merge), and a
+    # component below the robust vote floor degrades to the commutative
+    # mean with a catalogued gossip.vote_floor event. The heal has no
+    # arbiter: HELLO probes re-establish contact (the dormant-peer probe
+    # lane prevents split-brain-forever), version-vector merges absorb
+    # the other side's frontier, and per-peer chains reconcile pairwise
+    # through fork_point/verify_segment/merge_rows/adopt_merge.
+    # Preconditions: partition_groups name PEERS and the span is keyed
+    # on each peer's OWN autonomous round clock (validated below);
+    # proven by the chaos_smoke gossip-partition leg and
+    # scripts/dist_soak.py --partition
     ("per-round central eval",
      lambda c: c.eval_every != 0,
      {"local": True,

@@ -37,6 +37,24 @@ stalls its whole component until failover. Here NO peer is special:
   pairwise through the existing fork/merge API (``fork_point`` /
   ``verify_segment`` / ``merge_rows`` / ``adopt_merge``) whenever a sync
   lands, instead of converging on one consensus head.
+- **Partitions heal leaderlessly** (RUNTIME.md §9): the FaultPlan
+  partition lane's :class:`~bcfl_tpu.dist.transport.PartitionGate` cuts
+  the socket for any dispatch; here each component keeps converging on
+  its own clocks — neighbor draws stay inside the gate component, the
+  merge seam rejects frames buffered across the cut (the gossip scope of
+  ``no_cross_partition_merge``), and a component too small for the
+  configured robust rule degrades to the commutative mean with a
+  catalogued ``gossip.vote_floor`` event. The heal has NO arbiter: on
+  span exit the peer HELLO-probes everyone the cut hid, the answering
+  syncs fold in through the ordinary version-vector merge, and the chain
+  replicas reconcile pairwise through the fork/merge API above. A
+  periodic anti-entropy probe at one seeded DORMANT peer
+  (:func:`probe_targets`) backstops the beacon: the HELLO lane samples
+  the LIVE view only, so two detector-shrunk views would otherwise never
+  rediscover each other — split-brain forever. Trust stays on local wire
+  evidence, with one amnesty: a peer the cut (or the detector) hid takes
+  no staleness/outlier evidence until it arrives caught up
+  (:class:`RejoinGrace` — a partition is not malice).
 
 Termination is leaderless too: each peer trains its ``num_rounds`` local
 rounds (version == local merge count), drains briefly so late exchanges
@@ -46,6 +64,7 @@ still get served, announces "leaving", and exits 0 on its own clock.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -62,6 +81,7 @@ from bcfl_tpu.dist.runtime import (DurabilityError, MergeRecord,
 GOSSIP_LANE = 71
 HELLO_LANE = 72
 HEDGE_LANE = 73
+PROBE_LANE = 74
 
 
 def _walk_sorted(tree, prefix: str = ""):
@@ -120,6 +140,25 @@ def sample_neighbors(seed: int, round_idx: int, peer: int,
         (int(seed), int(lane), int(round_idx), int(peer)))
     pick = rng.choice(len(others), size=k, replace=False)
     return tuple(others[i] for i in sorted(pick))
+
+
+def probe_targets(seed: int, seq: int, peer: int,
+                  dormant: Tuple[int, ...], k: int = 1) -> Tuple[int, ...]:
+    """The seeded anti-entropy probe draw: up to ``k`` DORMANT peers
+    (static ids the live view does not currently contain) to HELLO at
+    this beacon tick. The beacon itself samples the LIVE view only, so
+    after a partition heals two detector-shrunk views would never
+    rediscover each other without this lane — split-brain forever. Keyed
+    ``(seed, PROBE_LANE, seq, peer)`` like every other topology draw:
+    same seed + same dormant set => same probes, on every host."""
+    pool = sorted(int(p) for p in dormant if int(p) != int(peer))
+    if not pool:
+        return ()
+    kk = min(int(k), len(pool))
+    rng = np.random.default_rng((int(seed), PROBE_LANE, int(seq),
+                                 int(peer)))
+    pick = rng.choice(len(pool), size=kk, replace=False)
+    return tuple(pool[i] for i in sorted(pick))
 
 
 def hedge_neighbors(seed: int, round_idx: int, peer: int,
@@ -204,6 +243,50 @@ def merge_states(items: List[Dict], decay: float):
     return merged, union, weights
 
 
+class RejoinGrace:
+    """Trust-evidence amnesty for peers a partition (or the failure
+    detector) hid — the partition-is-not-malice pin (ROBUSTNESS.md §6,
+    the slowness_is_not_malice precedent one lane over).
+
+    A peer that just re-entered the live view arrives STALE and, after a
+    long cut, state-DIVERGENT by construction. Without grace its first
+    contact draws exactly the evidence a byzantine peer draws: the
+    staleness lane, and — fatally — the outlier lane, whose weight
+    (``w_anomaly`` 0.5 >= ``strike_threshold`` 0.5) strikes a
+    probationary peer straight back to quarantine on ONE flag. Grace
+    suppresses those two gossip-path lanes for a rejoiner until its
+    first arrival lands within the staleness limit (caught up), at which
+    point normal evidence resumes. The detector-DOWN lane is untouched:
+    it is the one weak lane a cut is ALLOWED to charge, and it cannot
+    quarantine on its own (EWMA floor 1 - w_staleness stays above the
+    quarantine threshold). Merge weighting is untouched too — staleness
+    decay still crushes genuinely old state; grace only withholds the
+    *reputation* charge.
+
+    Thread-safe: rejoins land on the intake thread (``note_alive`` in
+    ``_intake_update``), clears on the main merge thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._graced: set = set()
+
+    def note_rejoin(self, peer: int) -> None:
+        with self._lock:
+            self._graced.add(int(peer))
+
+    def note_caught_up(self, peer: int) -> None:
+        with self._lock:
+            self._graced.discard(int(peer))
+
+    def active(self, peer: int) -> bool:
+        with self._lock:
+            return int(peer) in self._graced
+
+    def report(self) -> List[int]:
+        with self._lock:
+            return sorted(self._graced)
+
+
 class GossipPeerRuntime(PeerRuntime):
     """One peer process of the leaderless dispatch. Subclasses
     :class:`PeerRuntime` for everything that is not leader-shaped — the
@@ -241,6 +324,8 @@ class GossipPeerRuntime(PeerRuntime):
         self._peers_done: set = set()
         self._draining = False
         self._drain_started = 0.0
+        self._grace = RejoinGrace()
+        self._vote_floor_active = False  # rising-edge latch (vote_floor)
 
     # ------------------------------------------------------------- hooks
 
@@ -258,7 +343,7 @@ class GossipPeerRuntime(PeerRuntime):
         attempt counter) — same replayable topology discipline as the
         beacon, no leader to prefer."""
         mem = getattr(self, "membership", None)
-        live = (mem.live() if mem is not None
+        live = (self._reachable_live() if mem is not None
                 else tuple(range(self.peers)))
         return list(sample_neighbors(self.cfg.seed, self._sync_target_i,
                                      self.peer_id, live, 1, "epidemic",
@@ -291,6 +376,10 @@ class GossipPeerRuntime(PeerRuntime):
                 "auth_rejects": getattr(self, "_auth_rejects", 0),
                 "chain_merges": getattr(self, "_chain_merges", 0),
                 "peers_done": sorted(getattr(self, "_peers_done", ())),
+                "rejoin_graced": (self._grace.report()
+                                  if getattr(self, "_grace", None)
+                                  is not None else []),
+                "fork": getattr(self, "fork", None),
             },
         }
 
@@ -371,7 +460,7 @@ class GossipPeerRuntime(PeerRuntime):
 
         self._state_np = jax.tree.map(np.asarray,
                                       jax.device_get(self.trainable))
-        live = self.membership.live()
+        live = self._reachable_live()
         nbrs = sample_neighbors(cfg.seed, rnd, self.peer_id, live,
                                 cfg.dist.gossip_fanout,
                                 cfg.dist.gossip_topology)
@@ -445,6 +534,15 @@ class GossipPeerRuntime(PeerRuntime):
         # this is the observable staleness statistic)
         lag = max(int(self.vv.sum()) - int(vv.sum()), 0)
         rec["staleness"] = lag
+        if self.gate.components() is not None:
+            # merge-seam twin of the socket gate: a frame buffered BEFORE
+            # the cut opened (or raced past the recv gate) must not cross
+            # it at merge time — this is what makes the gossip scope of
+            # no_cross_partition_merge hold for real, not by construction
+            comp = self.gate.component_of(self.peer_id) or ()
+            if src not in comp:
+                rec["rejected"] = "cross-partition (span active)"
+                return {"ok": False, "rec": rec}
         if (self.rep is not None and src != self.peer_id
                 and self.rep.is_quarantined(src)):
             # post-ack quarantine gate at merge time — the seam the
@@ -480,7 +578,19 @@ class GossipPeerRuntime(PeerRuntime):
                                n=1, chain_len=len(self.chain),
                                rewrite=False,
                                head8=self.chain.head.hex()[:16])
-        if self.rep is not None and src != self.peer_id:
+        graced = src != self.peer_id and self._grace.active(src)
+        if graced and (self.rep is None
+                       or lag <= self.rep.cfg.staleness_limit):
+            # caught up: the amnesty lifts and normal evidence resumes
+            # (lag at/below the limit draws none anyway)
+            self._grace.note_caught_up(src)
+            graced = False
+        if graced:
+            # partition-is-not-malice: a rejoiner is stale by construction
+            # — no staleness charge until it arrives caught up (weight
+            # decay below still crushes genuinely old state)
+            rec["graced"] = True
+        elif self.rep is not None and src != self.peer_id:
             self.rep.note_staleness(src, lag)
         trust = 1.0
         if self.rep is not None:
@@ -532,9 +642,15 @@ class GossipPeerRuntime(PeerRuntime):
                     self._cast(merged))
                 self._state_np = merged
         self.version += 1
-        live = self.membership.live()
-        comp = sorted(set(live) | {a["peer"] for a in arrivals}
-                      | {self.peer_id})
+        if cfg.aggregator != "mean":
+            self._note_vote_floor(len(items) + 1)
+        # the component this merge claims: during an active span on this
+        # peer's own clock, the gate component (the scope the
+        # no_cross_partition_merge invariant checks arrivals against);
+        # otherwise the full static id space. NOT the live view unioned
+        # with the arrivals — that made the cross-partition check vacuous
+        # under gossip (every arrival was inside its own union)
+        comp = sorted(self.gate.component_of(self.peer_id) or ())
         rec = MergeRecord(
             version=self.version, leader=self.peer_id, arrivals=arrivals,
             rejected=rejected, wall_s=time.time() - t0,
@@ -574,6 +690,29 @@ class GossipPeerRuntime(PeerRuntime):
         self._note_version()
         self._maybe_checkpoint()
 
+    def _note_vote_floor(self, votes: int) -> None:
+        """Rising-edge catalogue of the RUNTIME vote floor: the static
+        config check (``gossip_fanout + 1 >= MIN_ORDER_VOTES``) only
+        guarantees the TOPOLOGY can feed the robust rule — a partition
+        (or churn) can still shrink the reachable cohort below it, at
+        which point the merge degrades to the commutative mean (solo
+        merges and the ``robust_degraded`` fallback). This event marks
+        each degradation episode's entry so a soak can count windows
+        without diffing per-merge records."""
+        from bcfl_tpu.dist.robust import MIN_ORDER_VOTES
+
+        if votes < MIN_ORDER_VOTES:
+            if not self._vote_floor_active:
+                self._vote_floor_active = True
+                telemetry.emit(
+                    "gossip.vote_floor", votes=int(votes),
+                    need=int(MIN_ORDER_VOTES), version=int(self.version),
+                    component=sorted(self.gate.component_of(self.peer_id)
+                                     or ()),
+                    rule=self.cfg.aggregator)
+        else:
+            self._vote_floor_active = False
+
     def _apply_robust_gossip(self, items: List[Dict], self_item: Dict):
         """Peer-local robust trimming: one vote per source (the sender's
         whole state), the configured order-statistic rule over the
@@ -611,7 +750,13 @@ class GossipPeerRuntime(PeerRuntime):
             for a in items:
                 if a is it:
                     a["rec"]["outlier"] = True
-            if self.rep is not None:
+            if self.rep is not None and not self._grace.active(p):
+                # a rejoiner's first post-heal state IS the cohort
+                # outlier by construction — keep the flag (trimming still
+                # protects the merge) but charge no trust evidence while
+                # graced: w_anomaly (0.5) >= strike_threshold (0.5) would
+                # send an honest probationary peer straight back to
+                # quarantine on one flag
                 self.rep.note_outlier(
                     p, distance=(dists[j] if dists else None))
         union = self.vv.copy()
@@ -627,6 +772,81 @@ class GossipPeerRuntime(PeerRuntime):
 
             self._state_np = jax.tree.map(np.asarray, agg)
         return info, False
+
+    # --------------------------------------------------- partition lifecycle
+
+    def _reachable_live(self) -> Tuple[int, ...]:
+        """The live view restricted to this peer's own partition component
+        while a span is active on its OWN round clock (outside a span the
+        live view passes through untouched). Sampling inside it keeps
+        every fanout slot useful during a cut — a draw at a peer across
+        the cut would only be dropped at the socket gate anyway."""
+        live = self.membership.live()
+        if self.gate.components() is None:
+            return live
+        comp = self.gate.component_of(self.peer_id) or ()
+        return tuple(p for p in live if p in comp)
+
+    def _probe(self, target: int) -> None:
+        """One anti-entropy HELLO at a peer the live view does not reach.
+        Cheap even when the target is dead: once the detector marks it
+        DOWN the circuit breaker skips the send (one budgeted probe per
+        ``probe_interval_s``), and a not-yet-DOWN dead target costs at
+        most one ``send_deadline_s``-bounded retry loop."""
+        header = {"type": "hello", "version": int(self.version),
+                  "probe": True}
+        if self.cfg.dist.pipeline:
+            self.transport.send_async(target, header)
+        else:
+            self.transport.send(target, header)
+
+    def _update_partition_state(self):
+        """Leaderless partition lifecycle: the SAME span observation as
+        the leadered path — the gate's components evaluated on this
+        peer's own autonomous round clock — but the heal has no arbiter.
+        On span entry the fork is catalogued (``fork.begin`` with
+        ``leaderless=True``); on exit nobody elects a reconcile leader
+        and nothing is offered to peer 0: the peer HELLO-probes every
+        peer the cut hid, the answering syncs fold in through the
+        ordinary version-vector merge, the chain replicas reconcile
+        pairwise in ``_handle_sync``, and the hidden peers enter rejoin
+        grace so their first (stale, divergent) contact draws no
+        staleness/outlier evidence."""
+        comps = self.gate.components()
+        if comps is not None and not self._partitioned:
+            self._partitioned = True
+            self._fork_comps = comps
+            comp = list(self.gate.component_of(self.peer_id) or ())
+            self.fork = {
+                "at_version": int(self.version),
+                "fork_base": (int(len(self.chain))
+                              if self.chain is not None else None),
+                "head_at_fork": self._head(),
+                "component": comp,
+            }
+            telemetry.emit("fork.begin", at_version=int(self.version),
+                           component=comp, leaderless=True,
+                           head8=(self._head() or "")[:16],
+                           fork_base=self.fork["fork_base"])
+            logger.info("peer %d: partition began at version %d "
+                        "(component %s, leaderless)", self.peer_id,
+                        self.version, comp)
+        elif comps is None and self._partitioned:
+            self._partitioned = False
+            self.fork["head_before_heal"] = self._head()
+            old_comp = set(self.fork.get("component") or ())
+            telemetry.emit("fork.heal", at_version=int(self.version),
+                           leaderless=True,
+                           head8=(self._head() or "")[:16])
+            hidden = [p for p in range(self.peers)
+                      if p != self.peer_id and p not in old_comp
+                      and p not in self._peers_done]
+            for p in hidden:
+                self._grace.note_rejoin(p)
+                self._probe(p)
+            logger.info("peer %d: partition healed at version %d — "
+                        "probing %s for anti-entropy", self.peer_id,
+                        self.version, hidden)
 
     # -------------------------------------------------- membership + resync
 
@@ -648,19 +868,29 @@ class GossipPeerRuntime(PeerRuntime):
     def _maybe_hello(self):
         """The HELLO beacon (steady state, not a rejoin special case):
         every ``gossip_hello_interval_s`` ping one seeded live neighbor;
-        whoever receives it answers with a full state+chain sync."""
+        whoever receives it answers with a full state+chain sync. On the
+        same tick, outside any partition span, one seeded DORMANT peer is
+        probed too (:func:`probe_targets`) — the anti-entropy backstop
+        that rediscovers peers the detector dropped (during a span the
+        probe is withheld: the gate would drop it at the socket)."""
         now = time.time()
         if now - self._last_hello_beacon < self.cfg.dist.gossip_hello_interval_s:
             return
         self._last_hello_beacon = now
         self._hello_seq += 1
         nbrs = sample_neighbors(self.cfg.seed, self._hello_seq,
-                                self.peer_id, self.membership.live(), 1,
+                                self.peer_id, self._reachable_live(), 1,
                                 "epidemic", lane=HELLO_LANE)
-        if not nbrs:
-            return
-        self.transport.send(nbrs[0], {"type": "hello",
-                                      "version": int(self.version)})
+        if nbrs:
+            self.transport.send(nbrs[0], {"type": "hello",
+                                          "version": int(self.version)})
+        if self.gate.components() is None:
+            # departed peers are dormant-but-done: never probed
+            dormant = tuple(p for p in self.membership.dormant()
+                            if p not in self._peers_done)
+            for t in probe_targets(self.cfg.seed, self._hello_seq,
+                                   self.peer_id, dormant):
+                self._probe(t)
 
     def _handle_gossip_hello(self, header: Dict):
         """ANY peer answers a hello (no leader gate): reply with the full
@@ -729,9 +959,11 @@ class GossipPeerRuntime(PeerRuntime):
 
     def _intake_update(self, header: Dict, trees: Dict):
         """Gossip intake: EVERY peer buffers (no leader check); any frame
-        re-attests its sender into the live view."""
+        re-attests its sender into the live view (a detector-hidden peer
+        re-entering it gets rejoin grace — partition is not malice)."""
         src = int(header.get("from", -1))
-        self.membership.note_alive(src)
+        if self.membership.note_alive(src):
+            self._grace.note_rejoin(src)
         if (self.rep is not None and src != self.peer_id
                 and self.rep.is_quarantined(src)):
             with self._qdrop_lock:
@@ -743,7 +975,8 @@ class GossipPeerRuntime(PeerRuntime):
         kind = header.get("type")
         src = int(header.get("from", -1))
         if src >= 0 and kind not in ("shutdown", "leaving"):
-            self.membership.note_alive(src)
+            if self.membership.note_alive(src):
+                self._grace.note_rejoin(src)
         if kind == "update":
             self._intake_update(header, trees)
         elif kind == "ping":
@@ -843,6 +1076,7 @@ class GossipPeerRuntime(PeerRuntime):
                     self._maybe_request_sync()
                     time.sleep(0.05)
                     continue
+                self._update_partition_state()
                 self._maybe_hello()
                 if self.version < self.cfg.num_rounds:
                     # train, then merge whatever arrived meanwhile: the

@@ -50,6 +50,17 @@ class MembershipView:
         with self._lock:
             return int(p) in self._live
 
+    def dormant(self) -> Tuple[int, ...]:
+        """Sorted static ids currently ABSENT from the live view — the
+        anti-entropy probe lane's candidate pool
+        (:func:`bcfl_tpu.dist.gossip.probe_targets`). The HELLO beacon
+        only samples ``live()``, so without a periodic probe at a dormant
+        peer two detector-shrunk views could never rediscover each other
+        after a partition heals — split-brain forever."""
+        with self._lock:
+            return tuple(p for p in range(self.peers)
+                         if p not in self._live)
+
     def note_alive(self, p: int) -> bool:
         """A frame arrived from ``p``: fold it (back) into the live view.
         Returns True when this was a re-entry (a join transition)."""

@@ -431,11 +431,19 @@ class PartitionGate:
 
     ``components`` come from :meth:`FaultPlan.partition_components` with the
     peer count as the population; the span clock is the owning peer's
-    **model version** (supplied via ``version_fn``), the dist analogue of
-    the local engine's round index — both sides traverse the span as their
-    own version counter crosses ``partition_rounds``. ``allowed(a, b)`` is
-    False iff the span is active on *this* peer's clock and ``a``/``b`` sit
-    in different components."""
+    **local round** (supplied via ``version_fn``), the dist analogue of
+    the local engine's round index — it advances with the peer's own
+    training loop, so both sides traverse the span as their own counter
+    crosses ``partition_rounds`` even while cross-partition messages are
+    dropped. That autonomy is what makes the gate dispatch-agnostic: a
+    leadered peer and a gossip peer (whose clocks never synchronize by
+    construction) each evaluate the SAME seeded component split against
+    their own counter, so the two sides of a cut agree on span
+    *membership* even when they disagree, briefly, on whether the span
+    is active (skew shows up as one side dropping at send while the
+    other still drops at recv — never as mismatched components).
+    ``allowed(a, b)`` is False iff the span is active on *this* peer's
+    clock and ``a``/``b`` sit in different components."""
 
     def __init__(self, plan: Optional[FaultPlan], peers: int,
                  version_fn: Callable[[], int]):

@@ -739,7 +739,11 @@ class FaultPlan:
         when the mesh is whole this round. The assignment is constant for
         the whole plan (seeded once, not per round), so a multi-round span
         keeps stable components and A/B seeds compare like the other
-        lanes."""
+        lanes. Under the dist runtime ``rnd`` is each peer's OWN local
+        round (the PartitionGate's autonomous clock): peers evaluate this
+        at different wall instants, and the constant assignment is what
+        guarantees they still agree on component membership — under
+        gossip dispatch there is no shared clock at all."""
         if not self.partitions or self.partition_rounds is None:
             return None
         if rnd not in self.partition_rounds:

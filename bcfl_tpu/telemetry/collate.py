@@ -100,8 +100,9 @@ def resolve_stream_dir(telemetry_dir: Optional[str],
 
 def causal_order(events: List[Dict]) -> List[Dict]:
     """Topologically order events under happens-before (per-stream ``seq``
-    chains + send->recv identity edges), using ``t_wall`` as the heap
-    priority — the causally-valid linearization closest to wall time.
+    chains + dead-incarnation -> restart edges + send->recv identity
+    edges), using ``t_wall`` as the heap priority — the causally-valid
+    linearization closest to wall time.
 
     Cycles CAN arise from real writers: a ``send`` event is emitted only
     after the ack (so its seq is late), while the frame itself may have
@@ -134,6 +135,23 @@ def causal_order(events: List[Dict]) -> List[Dict]:
         for a, b in zip(idxs, idxs[1:]):
             succ_seq[a].append(b)
             indeg[b] += 1
+    # incarnation chains: a restarted peer id cannot emit until the prior
+    # incarnation is dead, and both append to the same stream file, so
+    # every event of the earlier pid happens-before every event of the
+    # later one. Without this edge a restart can overtake its predecessor
+    # in the linearization whenever the old incarnation's seq chain stalls
+    # behind a late-recorded cross edge (sends are stamped at ack time),
+    # inverting incarnation order for rollback/readmission judgements.
+    # First file appearance orders incarnations; seq-class edge (ground
+    # truth, never dropped) — per-peer chains stay trivially acyclic.
+    by_peer: Dict = {}
+    for (peer, pid), idxs in by_stream.items():
+        by_peer.setdefault(peer, []).append((min(idxs), idxs))
+    for incarnations in by_peer.values():
+        incarnations.sort(key=lambda t: t[0])
+        for (_, prev), (_, nxt) in zip(incarnations, incarnations[1:]):
+            succ_seq[prev[-1]].append(nxt[0])
+            indeg[nxt[0]] += 1
     # cross-stream send -> recv edges on the transport identity
     sends: Dict = {}
     for i, e in enumerate(events):

@@ -81,6 +81,11 @@ EVENT_TYPES: Dict[str, tuple] = {
     "gossip.exchange": ("round", "neighbors"),
     "gossip.merge": ("version", "leader", "arrivals", "rejected", "solo",
                      "degraded", "component", "wall_s"),
+    # episode entry (rising edge): the peer's reachable cohort shrank
+    # below the robust rule's MIN_ORDER_VOTES — merges degrade to the
+    # commutative mean until the cohort recovers (partition minority
+    # components hit this by construction; the soak counts episodes)
+    "gossip.vote_floor": ("votes", "need"),
     # --- elastic membership (bcfl_tpu.dist.membership): one peer's LOCAL
     # live-view transitions (member joined/left the view, not the cluster)
     "membership.join": ("member", "live"),

@@ -24,8 +24,12 @@ rule consumes):
   a SIGKILLed receiver's unflushed buffer tail proves nothing.
 - **no_cross_partition_merge** — the partition gate drops frames whose
   origin is outside the receiver's component; a merge that composed an
-  update from a peer outside the leader's recorded component crossed a
-  partition that was supposed to exist.
+  update from a peer outside the merger's recorded component crossed a
+  partition that was supposed to exist. Scopes over BOTH dispatches: the
+  leader's component under leadered dispatch, and — since every gossip
+  peer is its own merge authority — each gossiping peer's own gate
+  component (``gossip.merge`` records the gate's view, and the merge
+  seam re-checks buffered arrivals against it during an active span).
 - **quarantine_evidence** — the reputation lifecycle quarantines only on
   observed evidence; a ``rep.transition`` to ``quarantined`` with no prior
   ``rep.evidence`` for that client in the same stream is a state machine
@@ -51,6 +55,19 @@ rule consumes):
   peer incarnation, by a ``state.sync.verify`` with ``ok: true`` that no
   earlier adopt already consumed. An unverified adoption is a peer
   accepting arbitrary state on faith.
+- **partition_heals_leaderless** — the leaderless partition contract
+  (RUNTIME.md §9): a gossip peer that recorded a ``fork.begin`` with
+  ``leaderless: true`` and closed its stream cleanly (``run.end``) must
+  (a) have recorded a matching ``fork.heal``, and (b) after the heal,
+  show cross-component contact — a send at, an accepted recv from, a
+  merged arrival from, or a membership join of a peer OUTSIDE the
+  recorded fork component (the heal-time anti-entropy probes guarantee
+  at least the send on a correct implementation, even when the other
+  side is dead). A SIGKILLed stream (no ``run.end``) proves nothing and
+  is exempt; so is the leadered protocol's ``fork.begin`` (no flag),
+  whose heal runs through the peer-0 reconcile instead. Skipped when the
+  span's component already covers every static peer (``run.start``'s
+  ``peers``) — there is no outside to contact.
 - **no_rollback_readmission** — a restarted peer whose durable state was
   rolled back (checkpoint chain shorter than an earlier incarnation's)
   must resync FORWARD before persisting: a ``ckpt.save`` whose
@@ -391,6 +408,87 @@ def slowness_is_not_malice(events: List[Dict]) -> List[Dict]:
     return out
 
 
+def partition_heals_leaderless(events: List[Dict]) -> List[Dict]:
+    # per peer incarnation (stream peer, pid), leaderless spans only.
+    # Stream order is the peer's own seq order, so "after the heal" is
+    # exactly "later in this stream". Output is sorted (peer, pid,
+    # at_version, problem): the verdict must not depend on which stream
+    # the collator (or the live monitor) happened to open first.
+    streams: Dict = {}  # (peer, pid) -> state
+    for e in events:
+        key = (_peer_of(e), e.get("pid"))
+        st = streams.setdefault(key, {"open": None, "awaiting": [],
+                                      "closed": False, "spans": [],
+                                      "peers": None})
+        ev = e.get("ev")
+        if ev == "run.start":
+            if e.get("peers") is not None:
+                st["peers"] = e.get("peers")
+        elif ev == "fork.begin" and e.get("leaderless"):
+            span = {"component": set(e.get("component") or ()),
+                    "at_version": e.get("at_version"),
+                    "healed": False, "contact": False}
+            st["spans"].append(span)
+            st["open"] = span
+        elif ev == "fork.heal" and st["open"] is not None:
+            st["open"]["healed"] = True
+            st["awaiting"].append(st["open"])
+            st["open"] = None
+        elif ev == "run.end":
+            st["closed"] = True
+        elif st["awaiting"]:
+            # any post-heal contact with a peer outside the span's
+            # component discharges the anti-entropy obligation
+            touched = []
+            if ev == "send":
+                touched = [e.get("to")]
+            elif ev == "recv" and e.get("disposition") == "accepted":
+                touched = [e.get("src")]
+            elif ev == "membership.join":
+                touched = [e.get("member")]
+            elif ev in MERGE_EVS:
+                touched = [a.get("peer") for a in e.get("arrivals") or []]
+            if touched:
+                still = []
+                for span in st["awaiting"]:
+                    if any(p is not None and p not in span["component"]
+                           for p in touched):
+                        span["contact"] = True
+                    else:
+                        still.append(span)
+                st["awaiting"] = still
+    out = []
+    for (peer, pid), st in streams.items():
+        if not st["closed"]:
+            continue  # SIGKILLed / unterminated stream: proves nothing
+        for span in st["spans"]:
+            n = st["peers"]
+            no_outside = n is not None and len(span["component"]) >= n
+            if not span["healed"]:
+                out.append({
+                    "rule": "partition_heals_leaderless",
+                    "problem": "leaderless partition span never healed "
+                               "before the peer's clean close",
+                    "peer": peer, "pid": pid,
+                    "at_version": span["at_version"],
+                    "component": sorted(span["component"]),
+                })
+            elif not span["contact"] and not no_outside:
+                out.append({
+                    "rule": "partition_heals_leaderless",
+                    "problem": "no cross-component contact after the "
+                               "leaderless heal — anti-entropy never "
+                               "attempted",
+                    "peer": peer, "pid": pid,
+                    "at_version": span["at_version"],
+                    "component": sorted(span["component"]),
+                })
+    out.sort(key=lambda v: (str(v["peer"]), str(v["pid"]),
+                            v["at_version"] if v["at_version"] is not None
+                            else -1, v["problem"]))
+    return out
+
+
 # name -> (check fn, one-line description); the collator and the trace CLI
 # walk this registry — adding a rule here adds it to every consumer
 INVARIANTS = {
@@ -426,6 +524,10 @@ INVARIANTS = {
         slowness_is_not_malice,
         "no peer-scoped quarantine rests on slowness evidence alone — "
         "gray failure down-weights, it never excludes"),
+    "partition_heals_leaderless": (
+        partition_heals_leaderless,
+        "every leaderless partition span on a cleanly-closed stream "
+        "heals and is followed by cross-component anti-entropy contact"),
 }
 
 

@@ -524,11 +524,105 @@ class SSlownessIsNotMalice(_StreamingCheck):
         return []
 
 
+class SPartitionHealsLeaderless(_StreamingCheck):
+    """Streaming form of ``partition_heals_leaderless``. All state is
+    per (peer, pid) stream — file order IS seq order, so the span
+    machine (fork.begin → fork.heal → post-heal contact → run.end) feeds
+    exactly as the batch check walks it. The judgment itself is only
+    decidable at end of stream (a span still awaiting contact may yet
+    get it, and an unterminated stream is exempt), so ``feed`` only
+    accumulates and ``finalize`` renders the exact batch verdict —
+    sorted the same way, so the parity contract holds regardless of
+    which stream the live collator opened first."""
+
+    name = "partition_heals_leaderless"
+
+    def __init__(self):
+        super().__init__()
+        self._streams: Dict = {}   # (peer, pid) -> state
+
+    def feed(self, e: Dict) -> List[Dict]:
+        key = (e.get("peer"), e.get("pid"))
+        st = self._streams.setdefault(key, {"open": None, "awaiting": [],
+                                            "closed": False, "spans": [],
+                                            "peers": None})
+        ev = e.get("ev")
+        if ev == "run.start":
+            if e.get("peers") is not None:
+                st["peers"] = e.get("peers")
+        elif ev == "fork.begin" and e.get("leaderless"):
+            span = {"component": set(e.get("component") or ()),
+                    "at_version": e.get("at_version"),
+                    "healed": False, "contact": False}
+            st["spans"].append(span)
+            st["open"] = span
+        elif ev == "fork.heal" and st["open"] is not None:
+            st["open"]["healed"] = True
+            st["awaiting"].append(st["open"])
+            st["open"] = None
+        elif ev == "run.end":
+            st["closed"] = True
+        elif st["awaiting"]:
+            touched = []
+            if ev == "send":
+                touched = [e.get("to")]
+            elif ev == "recv" and e.get("disposition") == "accepted":
+                touched = [e.get("src")]
+            elif ev == "membership.join":
+                touched = [e.get("member")]
+            elif ev in MERGE_EVS:
+                touched = [a.get("peer") for a in e.get("arrivals") or []]
+            if touched:
+                still = []
+                for span in st["awaiting"]:
+                    if any(p is not None and p not in span["component"]
+                           for p in touched):
+                        span["contact"] = True
+                    else:
+                        still.append(span)
+                st["awaiting"] = still
+        return []
+
+    def finalize(self) -> List[Dict]:
+        out: List[Dict] = []
+        for (peer, pid), st in self._streams.items():
+            if not st["closed"]:
+                continue
+            for span in st["spans"]:
+                n = st["peers"]
+                no_outside = (n is not None
+                              and len(span["component"]) >= n)
+                if not span["healed"]:
+                    out.append({
+                        "rule": self.name,
+                        "problem": "leaderless partition span never "
+                                   "healed before the peer's clean close",
+                        "peer": peer, "pid": pid,
+                        "at_version": span["at_version"],
+                        "component": sorted(span["component"])})
+                elif not span["contact"] and not no_outside:
+                    out.append({
+                        "rule": self.name,
+                        "problem": "no cross-component contact after the "
+                                   "leaderless heal — anti-entropy never "
+                                   "attempted",
+                        "peer": peer, "pid": pid,
+                        "at_version": span["at_version"],
+                        "component": sorted(span["component"])})
+        out.sort(key=lambda v: (str(v["peer"]), str(v["pid"]),
+                                v["at_version"]
+                                if v["at_version"] is not None else -1,
+                                v["problem"]))
+        self.out = out
+        return self.out
+
+
 # registry mirrors invariants.INVARIANTS key-for-key (tested)
 STREAMING_CHECKS = {c.name: c for c in (
     SNoDoubleMerge, SAckedNotLost, SNoCrossPartitionMerge,
     SQuarantineEvidence, SMonotoneHeads, SNoQuarantinedMerge,
-    SRepairAuthenticated, SNoRollbackReadmission, SSlownessIsNotMalice)}
+    SRepairAuthenticated, SNoRollbackReadmission, SSlownessIsNotMalice,
+    SPartitionHealsLeaderless)}
 
 
 class StreamingInvariantSuite:

@@ -534,6 +534,122 @@ EOF
 rc=$?
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 
+# Gossip-partition leg (RUNTIME.md §9, ROBUSTNESS.md §6): split-brain
+# survival WITHOUT a leader. 3 gossip peers, a seeded (0,1)|(2,) cut over
+# local rounds 1-2, and the minority peer (2) SIGKILLed mid-cut and LEFT
+# DEAD — the cruelest composition: the cut hides the death, so the
+# survivors only discover it through the post-heal anti-entropy probes.
+# Gates: both majority peers traverse the span on their OWN clocks
+# (leaderless fork.begin/fork.heal in each survivor stream), make
+# progress THROUGH the cut and reach the horizon, the batch trace is
+# clean — which includes zero no_cross_partition_merge hits over every
+# merging peer and the partition_heals_leaderless anti-entropy gate —
+# and the live monitor agrees verdict-for-verdict. The long-horizon
+# composition (partition x wire chaos x churn, unpartitioned-twin
+# convergence) is scripts/dist_soak.py --partition.
+echo
+echo "gossip-partition leg: 3 peers, seeded (0,1)|(2,) cut, SIGKILL of the minority mid-cut"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
+                             PartitionConfig)
+from bcfl_tpu.dist.harness import run_dist
+from bcfl_tpu.faults import FaultPlan
+from bcfl_tpu.telemetry import collate, read_stream
+
+run_dir = "/tmp/bcfl_chaos_gossip_part_run"
+if os.path.isdir(run_dir):
+    shutil.rmtree(run_dir)
+os.makedirs(run_dir)
+stop = os.path.join(run_dir, "monitor.stop")
+summary_path = "/tmp/bcfl_chaos_gossip_part_summary.json"
+mon = subprocess.Popen(
+    [sys.executable, "-m", "bcfl_tpu.entrypoints", "monitor", run_dir,
+     "--quiet", "--poll", "0.5", "--stop-file", stop,
+     "--summary-out", summary_path, "--max-wall", "500", "--idle", "400",
+     "--stall-critical-s", "600"])
+cfg = FedConfig(
+    name="gossip_part_smoke", runtime="dist", mode="server", sync="async",
+    model="tiny-bert", dataset="synthetic", num_clients=6, num_rounds=4,
+    seq_len=16, batch_size=4, max_local_batches=2, eval_every=0, seed=42,
+    partition=PartitionConfig(kind="iid", iid_samples=8),
+    ledger=LedgerConfig(enabled=True),
+    faults=FaultPlan(seed=7, partition_groups=((0, 1), (2,)),
+                     partition_rounds=(1, 2)),
+    dist=DistConfig(peers=3, dispatch="gossip", gossip_fanout=2,
+                    buffer_timeout_s=10.0, idle_timeout_s=90.0,
+                    peer_deadline_s=300.0, checkpoint_every_versions=1,
+                    suspect_after=1))
+try:
+    result = run_dist(cfg, run_dir, deadline_s=400.0, platform="cpu",
+                      kill_peer=2, kill_after_version=1,
+                      restart_killed=False)
+finally:
+    with open(stop, "w") as f:
+        f.write("done\n")
+mon_rc = mon.wait(timeout=120)
+rcs = result["returncodes"]
+reports = result["reports"]
+assert result["kill"] and not result["kill"]["restarted"], result["kill"]
+assert rcs["2"] not in (0, None), f"peer 2 survived the SIGKILL: {rcs}"
+for p in (0, 1):
+    assert rcs[str(p)] == 0, (p, rcs, result["log_tails"].get(p))
+    rep = reports.get(p) or {}
+    assert rep.get("status") == "ok", (p, rep.get("status"))
+    assert (rep.get("final_version") or 0) >= cfg.num_rounds, (
+        "a majority peer stalled through the cut", p,
+        rep.get("final_version"))
+    fork = (rep.get("gossip") or {}).get("fork") or {}
+    assert fork.get("component") == [0, 1], (p, fork)
+assert mon_rc == 0, f"live monitor exited {mon_rc} on the partition run"
+col = collate(result["event_streams"])
+col.pop("ordered")
+assert col["ok"], col["violations"]
+assert "no_cross_partition_merge" in col["invariants"], col["invariants"]
+assert not col["invariants"]["no_cross_partition_merge"], (
+    "a cross-partition merge slipped the gate", col["violations"])
+assert "partition_heals_leaderless" in col["invariants"], col["invariants"]
+assert not col["invariants"]["partition_heals_leaderless"], (
+    col["violations"])
+with open(summary_path) as f:
+    mon_summary = json.load(f)
+assert mon_summary["invariants"] == col["invariants"], (
+    "monitor-vs-trace verdict drift", mon_summary["invariants"],
+    col["invariants"])
+forks = heals = in_cut_merges = 0
+for path in result["event_streams"]:
+    evs, _ = read_stream(path)
+    peer = next((e.get("peer") for e in evs if "peer" in e), None)
+    for e in evs:
+        if e["ev"] == "fork.begin":
+            assert e.get("leaderless") is True, (
+                "a leadered fork record in a gossip run", e)
+            forks += 1
+        elif e["ev"] == "fork.heal":
+            assert e.get("leaderless") is True, e
+            heals += 1
+        elif (e["ev"] == "gossip.merge"
+              and sorted(e.get("component") or []) == [0, 1]):
+            in_cut_merges += 1
+assert forks >= 2 and heals >= 2, (
+    "each survivor traverses the span on its own clock", forks, heals)
+assert in_cut_merges > 0, (
+    "the majority component never merged during the cut — no "
+    "per-component progress to prove")
+print("gossip-partition leg: survivors reached version "
+      f"{[reports[p]['final_version'] for p in (0, 1)]} through the cut "
+      f"({in_cut_merges} in-cut merges, {forks} forks / {heals} heals, "
+      "all leaderless), peer-2 SIGKILL absorbed, zero cross-partition "
+      "merges, monitor + batch trace CLEAN")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
 # Storage-chaos leg (ROBUSTNESS.md §10 "Durable-state adversary model"):
 # 2 peers, follower SIGKILLed mid-run, its NEWEST committed checkpoint
 # bit-flipped WHILE IT IS DOWN (supervisor-side injection — the media

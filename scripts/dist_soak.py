@@ -29,6 +29,22 @@ long-horizon composition none of them exercises:
   the tampered transfer provably refused — on top of the zero-violation
   gates, which now include ``repair_authenticated`` and
   ``no_rollback_readmission``,
+- **partition (``--partition``, gossip only)** — the split-brain
+  adversary (RUNTIME.md §9, ISSUE 20): a seeded majority|minority cut —
+  the last peer alone on the far side — active over a window of each
+  peer's OWN local rounds, composed with wire chaos + churn. The
+  byzantine lane is DISARMED on this leg: the minority peer is
+  honest-but-HIDDEN, and the acceptance question is whether a cut alone
+  can cost it progress or standing. Extra gates: every peer traverses
+  the span on its own clock and heals leaderlessly (fork.begin/fork.heal
+  pairs carrying the ``leaderless`` flag), the minority's solo merges
+  degrade to mean with catalogued ``gossip.vote_floor`` events, the
+  per-component ledger chains reconcile pairwise (``adopt_merge``
+  observed), ZERO ``partition_heals_leaderless`` and
+  ``no_cross_partition_merge`` violations, the hidden minority is never
+  quarantined anywhere in the fleet, and the mean final eval loss lands
+  within ``--converge-tol`` of an UNPARTITIONED TWIN (identical shape,
+  seed, wire+churn plan, same dispatch, cut off),
 - **limp (``--limp``)** — the gray-failure adversary (ROBUSTNESS.md §11):
   one peer is SLOW instead of dead or malicious. The in-process seeded
   lane (``FaultPlan.limp_*``) stalls its train step and throttles its
@@ -85,6 +101,7 @@ assumed).
 Usage: python scripts/dist_soak.py [--rounds 120] [--peers 3]
            [--deadline 2700] [--platform cpu] [--quick]
            [--dispatch {leader,gossip}] [--storage] [--limp]
+           [--dispatch gossip --partition]
 """
 
 from __future__ import annotations
@@ -114,7 +131,19 @@ def _mean_final_loss(reports):
     return (sum(losses) / len(losses)) if losses else None
 
 
-def build_cfg(args, dispatch=None, name="dist_soak", limp=None):
+def partition_span(rounds: int):
+    """The soaked cut's active local rounds: a contiguous window deep
+    enough into the horizon that both components carry real state into
+    the split, long enough that each side commits several solo merges,
+    and healed early enough that post-heal anti-entropy and the
+    convergence gate have most of the horizon left."""
+    start = max(2, rounds // 6)
+    length = max(2, rounds // 8)
+    return tuple(range(start, start + length))
+
+
+def build_cfg(args, dispatch=None, name="dist_soak", limp=None,
+              partition=None):
     from bcfl_tpu.config import (DistConfig, FedConfig, LedgerConfig,
                                  PartitionConfig)
     from bcfl_tpu.faults import FaultPlan
@@ -129,6 +158,14 @@ def build_cfg(args, dispatch=None, name="dist_soak", limp=None):
     # limp=False to get an identical config that differs ONLY in limping.
     leg_limp = bool(getattr(args, "limp", False))
     limp = leg_limp if limp is None else bool(limp)
+    # leg_partition: the --partition LEG (byzantine disarmed — the
+    # minority peer is honest-but-HIDDEN, and the acceptance question is
+    # whether a cut alone can cost it standing). The `partition` param
+    # controls whether the cut is ARMED: the unpartitioned twin passes
+    # partition=False for the identical config that differs only in the
+    # cut — the reference that isolates what the split-brain cost.
+    leg_partition = bool(getattr(args, "partition", False))
+    partition = leg_partition if partition is None else bool(partition)
     plan = FaultPlan(
         seed=args.chaos_seed,
         wire_drop_prob=args.wire_drop, wire_dup_prob=args.wire_dup,
@@ -137,10 +174,18 @@ def build_cfg(args, dispatch=None, name="dist_soak", limp=None):
         wire_corrupt_prob=args.wire_corrupt,
         # the adversary lies for the WHOLE horizon, not a burst — except
         # on the limp leg, where the last peer is honest-but-slow instead
-        # of malicious (the gray-failure composition is limp+wire+churn)
-        **({} if leg_limp else
+        # of malicious (the gray-failure composition is limp+wire+churn),
+        # and the partition leg, where it is honest-but-hidden (the
+        # split-brain composition is partition+wire+churn)
+        **({} if (leg_limp or leg_partition) else
            {"byz_peers": (args.peers - 1,), "byz_prob": 1.0,
             "byz_behaviors": ("scale", "digest_forge")}),
+        # partition lane: a seeded majority|minority cut — the last peer
+        # alone on the far side — over each peer's OWN local-round clock
+        **({"partition_groups": (tuple(range(args.peers - 1)),
+                                 (args.peers - 1,)),
+            "partition_rounds": partition_span(args.rounds)}
+           if partition else {}),
         # limp lane (in-process half): seeded per-(peer, round) train
         # stalls + direction-keyed link throttling of the slow peer
         **({"limp_peers": (args.peers - 1,),
@@ -272,6 +317,15 @@ def main(argv=None) -> int:
                     help="seconds between supervisor pause cycles")
     ap.add_argument("--limp-pause", type=float, default=3.0,
                     help="seconds the peer stays frozen per cycle")
+    ap.add_argument("--partition", action="store_true",
+                    help="arm the split-brain adversary under gossip: a "
+                         "seeded majority|minority cut (the last peer "
+                         "alone) over the peers' own local-round clocks, "
+                         "composed with wire chaos + churn; gates on "
+                         "per-component progress, the leaderless "
+                         "anti-entropy heal, never-quarantine of the "
+                         "hidden peer, and convergence vs an "
+                         "UNPARTITIONED twin (RUNTIME.md §9)")
     ap.add_argument("--dispatch", choices=("leader", "gossip"),
                     default="leader",
                     help="dist execution mode; 'gossip' soaks the "
@@ -312,6 +366,15 @@ def main(argv=None) -> int:
         print("dist_soak needs >= 3 peers (trimmed_mean around one "
               "adversary + a churning follower)", file=sys.stderr)
         return 2
+    if args.partition and args.dispatch != "gossip":
+        print("--partition soaks the LEADERLESS heal: run it with "
+              "--dispatch gossip (the leadered fork/reconcile path has "
+              "its own proofs)", file=sys.stderr)
+        return 2
+    if args.partition and (args.limp or args.storage):
+        print("--partition composes wire+churn only; --limp/--storage "
+              "are separate legs", file=sys.stderr)
+        return 2
 
     from bcfl_tpu.dist import harness
     from bcfl_tpu.telemetry import collate
@@ -345,7 +408,9 @@ def main(argv=None) -> int:
              "stop_after_s": args.deadline * 0.5}
             if args.limp else None)
 
-    lanes = ("wire+limp+churn" if args.limp else "wire+byzantine+churn") \
+    lanes = ("wire+limp+churn" if args.limp
+             else "wire+partition+churn" if args.partition
+             else "wire+byzantine+churn") \
         + ("+storage" if args.storage else "")
     print(f"dist_soak[{args.dispatch}]: {args.peers} peers x "
           f"{args.clients // args.peers} clients, target {args.rounds} "
@@ -438,6 +503,12 @@ def main(argv=None) -> int:
     phi_samples = 0                  # detector.phi suspicion series
     slowness_evidence = 0            # rep.dist_evidence source=slowness
     limp_peer_quarantines = 0        # rep.transition -> quarantined, target
+    minority_peer = args.peers - 1   # alone on the cut's far side
+    leaderless_forks = 0             # fork.begin with the leaderless flag
+    leaderless_heals = 0             # fork.heal with the leaderless flag
+    vote_floor_events = 0            # gossip.vote_floor (degrade-to-mean)
+    adopt_merges = 0                 # ledger op=adopt_merge (chain heal)
+    minority_quarantines = 0         # rep.transition -> quarantined, target
     for path in result["event_streams"]:
         evs, _ = read_stream(path)
         for e in evs:
@@ -446,6 +517,18 @@ def main(argv=None) -> int:
                 resource_samples += 1
             elif ev in ("membership.join", "membership.leave"):
                 membership_events += 1
+            elif ev == "fork.begin" and e.get("leaderless"):
+                leaderless_forks += 1
+            elif ev == "fork.heal" and e.get("leaderless"):
+                leaderless_heals += 1
+            elif ev == "gossip.vote_floor":
+                vote_floor_events += 1
+            elif ev == "ledger" and e.get("op") == "adopt_merge":
+                adopt_merges += 1
+            elif (ev == "rep.transition" and e.get("scope") == "peer"
+                    and e.get("to") == "quarantined"
+                    and e.get("client") == minority_peer):
+                minority_quarantines += 1
             elif ev == "chaos" and e.get("lane") == "storage":
                 storage_chaos_classes.add(e.get("action"))
             elif ev == "state.sync.adopt":
@@ -492,11 +575,15 @@ def main(argv=None) -> int:
         if os.path.isdir(twin_dir):
             shutil.rmtree(twin_dir)
         os.makedirs(twin_dir, exist_ok=True)
-        kind = "unlimped" if args.limp else "leadered"
+        kind = ("unlimped" if args.limp
+                else "unpartitioned" if args.partition else "leadered")
         print(f"dist_soak: launching {kind} twin (convergence "
               f"reference) -> {twin_dir}", flush=True)
         twin_cfg = (build_cfg(args, name="dist_soak_twin", limp=False)
                     if args.limp else
+                    build_cfg(args, name="dist_soak_twin",
+                              partition=False)
+                    if args.partition else
                     build_cfg(args, dispatch="leader",
                               name="dist_soak_twin"))
         twin_result = harness.run_dist(
@@ -534,13 +621,41 @@ def main(argv=None) -> int:
             rep.get("chain_ok") in (True, None)
             for rep in reports.values()),
     }
-    if not args.limp:
-        # byzantine lane gates (disarmed on the limp leg by design)
+    if not args.limp and not args.partition:
+        # byzantine lane gates (disarmed on the limp/partition legs)
         gates["byz_injections_nonzero"] = byz_total > 0
         gates["adversary_distrusted"] = (
             adv_state == "quarantined"
             or (adv_trust is not None and adv_trust < 0.7))
-    else:
+    if args.partition:
+        # split-brain acceptance (ISSUE 20): every peer traversed the cut
+        # on its OWN clock and healed leaderlessly (fork.begin/fork.heal
+        # pairs with the leaderless flag in the streams), the minority's
+        # solo merges hit the robust vote floor and degraded to mean with
+        # a catalogued event, the per-component ledger forks reconciled
+        # pairwise (adopt_merge observed), the heal gate and the
+        # cross-partition merge gate are clean (also inside col["ok"],
+        # asserted explicitly so a registry drift cannot silently
+        # vacuate them), the hidden-but-honest minority was NEVER
+        # quarantined, and the cut fleet converges on its unpartitioned
+        # twin — the cut cost wall-clock, not correctness
+        gates["partition_forks_and_heals_observed"] = (
+            leaderless_forks > 0 and leaderless_heals > 0)
+        gates["vote_floor_degradation_observed"] = vote_floor_events > 0
+        gates["ledger_anti_entropy_merges_observed"] = adopt_merges > 0
+        gates["zero_partition_heals_leaderless_violations"] = (
+            batch_inv.get("partition_heals_leaderless", 1) == 0)
+        gates["zero_cross_partition_merges"] = (
+            batch_inv.get("no_cross_partition_merge", 1) == 0)
+        gates["hidden_minority_never_quarantined"] = (
+            minority_quarantines == 0)
+        twin_loss = twin["loss"] if twin else None
+        part_loss = _mean_final_loss(reports)
+        gates["partition_converged_vs_unpartitioned_twin"] = (
+            part_loss is not None and twin_loss is not None
+            and abs(part_loss - twin_loss)
+            <= args.converge_tol * max(abs(twin_loss), 1e-6))
+    if args.limp:
         # gray-failure acceptance (ISSUE 18): the lanes actually fired,
         # the phi estimator's suspicion series landed, slowness evidence
         # accrued, and the honest-slow peer was down-weighted — NEVER
@@ -582,9 +697,10 @@ def main(argv=None) -> int:
         # kill/rejoin cycles show up as catalogued membership.leave /
         # membership.join transitions in the survivors' streams
         gates["membership_churn_observed"] = membership_events > 0
-        if not args.limp:
-            # the limp leg's twin is the unlimped SAME-dispatch fleet
-            # (gated above), not the leadered reference
+        if not args.limp and not args.partition:
+            # the limp/partition legs' twins are same-dispatch fleets
+            # with that one lane off (gated above), not the leadered
+            # reference
             twin_loss = twin["loss"] if twin else None
             gates["gossip_converged_vs_leadered_twin"] = (
                 gossip_loss is not None and twin_loss is not None
@@ -621,6 +737,16 @@ def main(argv=None) -> int:
                 "slow_at_leader": (leader_rep.get("slow")
                                    or [None] * args.peers)[limp_peer],
             } if args.limp else None),
+            "partition": ({
+                "armed": True,
+                "groups": [list(range(args.peers - 1)), [minority_peer]],
+                "rounds": list(partition_span(args.rounds)),
+                "leaderless_forks": leaderless_forks,
+                "leaderless_heals": leaderless_heals,
+                "vote_floor_events": vote_floor_events,
+                "adopt_merges": adopt_merges,
+                "minority_quarantines": minority_quarantines,
+            } if args.partition else None),
             "storage": ({
                 "armed": True, "prob": args.storage_prob,
                 "classes_injected": sorted(storage_damage_classes),

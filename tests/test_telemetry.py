@@ -329,6 +329,35 @@ def test_causal_order_survives_real_writer_cycle():
     assert a_seqs == sorted(a_seqs) and b_seqs == sorted(b_seqs)
 
 
+def test_causal_order_restart_cannot_overtake_dead_incarnation():
+    # B's original incarnation recvs a frame whose send A only records at
+    # ack time, seconds later (chaos retries). Without a dead->restart
+    # edge the original's seq chain stalls on that cross edge while the
+    # restart's events sail past it in the wall-time heap — the restart's
+    # LONGER checkpoint then precedes the original's shorter one and
+    # no_rollback_readmission reports a phantom rollback (seen live in
+    # the gossip partition soak under wire chaos + churn).
+    send = _send("A", 1, 970.0, to="B", msg_id=9)     # stamped at ack
+    recv = _recv("B", 1, 900.0, src="A", msg_id=9)
+    recv["pid"] = 111
+    events = [
+        _ev("round", "A", 0, 100.0, pid=send["pid"], round=0, wall_s=0.1),
+        send,
+        _ev("run.start", "B", 0, 890.0, pid=111, role="peer"),
+        recv,
+        _ev("ckpt.save", "B", 2, 940.0, pid=111, chain_len=26,
+            round=5, wall_s=0.1),
+        _ev("ckpt.save", "B", 0, 968.0, pid=222, chain_len=36,
+            round=10, wall_s=0.1),                    # the restart
+    ]
+    ordered = T.causal_order(events)
+    saves = [(e["pid"], e["chain_len"]) for e in ordered
+             if e["ev"] == "ckpt.save"]
+    assert saves == [(111, 26), (222, 36)]
+    out = T.run_invariants(ordered)
+    assert out["no_rollback_readmission"] == []
+
+
 def test_cross_partition_merge_detected():
     events = _clean_run()
     events[2]["component"] = ["B", "C"]  # A is outside the leader's side
